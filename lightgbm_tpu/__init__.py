@@ -1,0 +1,32 @@
+"""lightgbm_tpu — a TPU-native gradient-boosted decision tree framework.
+
+Ground-up JAX/XLA/Pallas rebuild of the capabilities of LightGBM
+(reference: veneres/LightGBM v4.6.0.99). Not a port: histograms are MXU
+one-hot matmuls, tree growth is a fixed-shape on-device loop, distributed
+training is jax.sharding over ICI/DCN instead of sockets/MPI.
+
+Public API mirrors the reference Python package
+(``python-package/lightgbm/__init__.py``).
+"""
+
+from .binning import BinMapper
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .dataset import Dataset
+from .engine import Booster, CVBooster, cv, train
+from .tree import Tree
+
+try:  # sklearn-style wrappers need scikit-learn at import time
+    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                          LGBMRegressor)
+    _SKLEARN = ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    _SKLEARN = []
+
+__version__ = "0.1.0"
+
+__all__ = ["Dataset", "Booster", "CVBooster", "train", "cv", "Config",
+           "BinMapper", "Tree", "early_stopping", "log_evaluation",
+           "record_evaluation", "reset_parameter",
+           "EarlyStopException"] + _SKLEARN

@@ -1,0 +1,1 @@
+"""Boosting strategies: GBDT training loop, DART, RF, sampling."""

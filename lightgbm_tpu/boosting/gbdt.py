@@ -1,0 +1,309 @@
+"""GBDT training loop.
+
+TPU-native analog of the reference boosting layer
+(``src/boosting/gbdt.cpp``: ``Train`` :237, ``TrainOneIter`` :344,
+``BoostFromAverage`` :319, ``UpdateScore`` :491; sampling strategies
+``bagging.hpp`` / ``goss.hpp``).
+
+Structure (TPU-first):
+- Scores live on device as [num_class, padded_rows] f32; each iteration is:
+  grad/hess (jit) -> sampling mask (jit) -> build_tree (jit, one compiled
+  program per tree — the CUDA learner's whole-loop-on-device shape) ->
+  score gather-update (jit). Only the finished tree's small node arrays
+  come back to host per iteration, mirroring the CUDA learner's
+  scalars-only host boundary (cuda_single_gpu_tree_learner.cpp:246-273).
+- Bagging/GOSS produce a row mask/scale, never a data subset: fixed shapes
+  keep one compiled program alive. The mask rides in the histogram count
+  channel so min_data_in_leaf counts in-bag rows like the reference.
+- The init score (BoostFromAverage) is added into the first tree per class
+  via AddBias, exactly like gbdt.cpp:416 — saved models are self-contained.
+- Validation sets are co-partitioned during growth (see tree_builder), so
+  validation scores update with a gather, no full predict pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..objectives import Objective
+from ..ops.histogram import block_rows_for
+from ..ops.split import SplitParams
+from ..tree import Tree
+from .tree_builder import build_tree, TreeArrays
+
+__all__ = ["GBDT"]
+
+kEpsilon = 1e-15
+
+
+def _pad_rows(arr: np.ndarray, r_pad: int, fill=0):
+    if arr.shape[0] == r_pad:
+        return arr
+    pad = [(0, r_pad - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad, constant_values=fill)
+
+
+class _DeviceData:
+    """Device-resident binned matrix + co-partition state for one dataset."""
+
+    def __init__(self, ds: Dataset, block: int):
+        self.num_data = ds.num_data
+        self.r_pad = ((ds.num_data + block - 1) // block) * block
+        self.bins = jnp.asarray(_pad_rows(ds.bins, self.r_pad))
+        self.row_leaf0 = jnp.asarray(
+            np.where(np.arange(self.r_pad) < ds.num_data, 0, -1)
+            .astype(np.int32))
+
+
+class GBDT:
+    def __init__(self, config: Config, train_set: Dataset,
+                 objective: Optional[Objective],
+                 valid_sets: Sequence[Dataset] = ()):
+        self.config = config
+        self.train_set = train_set.construct()
+        self.objective = objective
+        self.iter_ = 0
+        self.models: List[Tree] = []
+        self.num_class = config.num_class
+        self.K = (objective.num_model_per_iteration
+                  if objective is not None else max(1, config.num_class))
+        self.shrinkage = config.learning_rate
+        self._init_scores = np.zeros(self.K)
+        self._boosted_from_average = False
+
+        F = self.train_set.num_features
+        self.B = int(self.train_set.max_num_bin)
+        self.block = block_rows_for(self.train_set.num_data, F, self.B)
+        self.train_dd = _DeviceData(self.train_set, self.block)
+        self.valid_dd = [
+            _DeviceData(v.construct(), self.block) for v in valid_sets]
+        self.valid_sets = list(valid_sets)
+
+        R = self.train_dd.r_pad
+        lbl = self.train_set.get_label()
+        self.label_dev = jnp.asarray(
+            _pad_rows(np.asarray(lbl, np.float32), R))
+        w = self.train_set.get_weight()
+        self.weight_dev = None if w is None else jnp.asarray(
+            _pad_rows(np.asarray(w, np.float32), R))
+
+        if objective is not None:
+            objective.init(lbl, w, self.train_set.query_boundaries())
+            self._init_scores = np.asarray(objective.boost_from_score(),
+                                           dtype=np.float64).reshape(-1)
+            if len(self._init_scores) != self.K:
+                self._init_scores = np.resize(self._init_scores, self.K)
+
+        self.scores = jnp.zeros((self.K, R), jnp.float32)
+        if self.config.boost_from_average and objective is not None:
+            self.scores = self.scores + jnp.asarray(
+                self._init_scores, jnp.float32)[:, None]
+            self._boosted_from_average = True
+        else:
+            self._init_scores = np.zeros(self.K)
+        self.valid_scores = [
+            jnp.zeros((self.K, dd.r_pad), jnp.float32)
+            + (jnp.asarray(self._init_scores, jnp.float32)[:, None]
+               if self._boosted_from_average else 0.0)
+            for dd in self.valid_dd]
+
+        # static metadata for the tree builder
+        self.num_bins_pf = jnp.asarray(self.train_set.per_feature_num_bins())
+        self.nan_bin_pf = jnp.asarray(self.train_set.per_feature_nan_bins())
+        self.is_cat_pf = jnp.asarray(
+            self.train_set.per_feature_is_categorical())
+        self.split_params = SplitParams(
+            lambda_l1=float(config.lambda_l1),
+            lambda_l2=float(config.lambda_l2),
+            min_data_in_leaf=float(config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=float(config.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(config.min_gain_to_split),
+            cat_l2=float(config.cat_l2),
+            cat_smooth=float(config.cat_smooth),
+            max_delta_step=float(config.max_delta_step))
+
+        self._rng_feature = np.random.RandomState(config.feature_fraction_seed)
+        self._rng_bagging = np.random.RandomState(config.bagging_seed)
+        self._bag_mask = None  # device [R] f32, regenerated per bagging_freq
+        self._goss = (config.data_sample_strategy == "goss")
+        if self._goss:
+            if config.top_rate + config.other_rate > 1.0:
+                raise ValueError("top_rate + other_rate must be <= 1")
+
+        self._update_score_jit = jax.jit(self._update_score_impl)
+        self._goss_jit = jax.jit(self._goss_impl)
+
+    # ------------------------------------------------------------------
+    def _grads(self, it: int) -> Tuple[jax.Array, jax.Array]:
+        """[K, R] grad and hess from the objective."""
+        obj = self.objective
+        if obj.num_model_per_iteration > 1:
+            g, h = obj.get_gradients(self.scores.T, self.label_dev,
+                                     self.weight_dev)
+            return g.T, h.T
+        kwargs = {}
+        if obj.is_ranking:
+            kwargs["it"] = jnp.asarray(it, jnp.int32)
+        g, h = obj.get_gradients(self.scores[0], self.label_dev,
+                                 self.weight_dev, **kwargs)
+        return g[None, :], h[None, :]
+
+    @staticmethod
+    def _update_score_impl(scores_k, leaf_values, row_leaf, lr):
+        rlc = jnp.where(row_leaf >= 0, row_leaf, leaf_values.shape[0] - 1)
+        add = jnp.take(leaf_values, rlc) * lr
+        return scores_k + jnp.where(row_leaf >= 0, add, 0.0)
+
+    def _goss_impl(self, g, h, key):
+        """GOSS mask+amplify (goss.hpp Helper): keep top `top_rate` rows by
+        sum_k |g*h|, sample `other_rate` of the rest, amplify their grads."""
+        cfg = self.config
+        R = g.shape[1]
+        n_real = self.train_dd.num_data
+        real = (self.train_dd.row_leaf0 >= 0).astype(jnp.float32)
+        # padded rows DO carry gradients (label 0 vs init score) — mask them
+        # out of the ranking or they displace real rows from the top set
+        score = jnp.sum(jnp.abs(g * h), axis=0) * real
+        top_k = max(1, int(n_real * cfg.top_rate))
+        other_k = max(1, int(n_real * cfg.other_rate))
+        kth = jnp.sort(score)[R - top_k]  # padded rows score 0, sink low
+        is_top = score >= kth
+        u = jax.random.uniform(key, (R,))
+        rest = ~is_top & (self.train_dd.row_leaf0 >= 0)
+        p_keep = other_k / max(1, n_real - top_k)
+        sampled = rest & (u < p_keep)
+        amp = (1.0 - cfg.top_rate) / cfg.other_rate
+        mask = is_top.astype(jnp.float32) + sampled.astype(jnp.float32)
+        scale = jnp.where(sampled, amp, 1.0) * mask
+        return g * scale[None, :], h * scale[None, :], mask
+
+    def _sampling(self, it: int, g: jax.Array, h: jax.Array):
+        """Returns (g, h, count_mask [R] f32)."""
+        cfg = self.config
+        R = self.train_dd.r_pad
+        real = self.train_dd.row_leaf0 >= 0
+        base_mask = real.astype(jnp.float32)
+        if self._goss:
+            # reference skips GOSS for the first 1/learning_rate iterations
+            if it >= int(1.0 / cfg.learning_rate):
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(cfg.bagging_seed), it)
+                return self._goss_jit(g, h, key)
+            return g, h, base_mask
+        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+            if it % cfg.bagging_freq == 0 or self._bag_mask is None:
+                n = self.train_dd.num_data
+                cnt = max(1, int(n * cfg.bagging_fraction))
+                idx = self._rng_bagging.choice(n, cnt, replace=False)
+                m = np.zeros(R, np.float32)
+                m[idx] = 1.0
+                self._bag_mask = jnp.asarray(m)
+            mask = self._bag_mask
+            return g * mask, h * mask, mask
+        return g, h, base_mask
+
+    def _feature_mask(self) -> jax.Array:
+        cfg = self.config
+        F = self.train_set.num_features
+        if cfg.feature_fraction >= 1.0:
+            return jnp.ones((F,), bool)
+        k = max(1, int(F * cfg.feature_fraction))
+        idx = self._rng_feature.choice(F, k, replace=False)
+        m = np.zeros(F, bool)
+        m[idx] = True
+        return jnp.asarray(m)
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, gradients: Optional[np.ndarray] = None,
+                       hessians: Optional[np.ndarray] = None) -> bool:
+        """Returns True when training should stop (no splits possible)."""
+        cfg = self.config
+        R = self.train_dd.r_pad
+        if gradients is None or hessians is None:
+            g, h = self._grads(self.iter_)
+        else:
+            # custom fobj arrays: flat [K*num_data] in class-major order
+            # (LGBM_BoosterUpdateOneIterCustom layout) or [num_data, K]
+            def prep(a):
+                a = np.asarray(a, np.float32)
+                n = self.train_dd.num_data
+                if a.ndim == 1:
+                    a = a.reshape(self.K, n)
+                else:
+                    a = a.T
+                return jnp.asarray(_pad_rows(a.T, R)).T
+            g, h = prep(gradients), prep(hessians)
+        g, h, count_mask = self._sampling(self.iter_, g, h)
+
+        fmask = self._feature_mask()
+        should_continue = False
+        for k in range(self.K):
+            gh = jnp.stack([g[k], h[k], count_mask], axis=1)
+            tree_arrays, row_leaf, valid_rls = build_tree(
+                self.train_dd.bins, gh, self.train_dd.row_leaf0,
+                self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
+                num_leaves=cfg.num_leaves, leaf_batch=cfg.leaf_batch,
+                max_depth=cfg.max_depth, num_bins=self.B,
+                split_params=self.split_params,
+                hist_dtype=cfg.hist_dtype, block_rows=self.block,
+                valid_bins=tuple(dd.bins for dd in self.valid_dd),
+                valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd))
+            host = jax.tree.map(np.asarray, tree_arrays)
+            num_leaves_trained = int(host.num_leaves)
+            shrink = 1.0 if self.config.boosting == "rf" else self.shrinkage
+            if num_leaves_trained > 1:
+                should_continue = True
+                lr = jnp.asarray(shrink, jnp.float32)
+                self.scores = self.scores.at[k].set(self._update_score_jit(
+                    self.scores[k], tree_arrays.leaf_values, row_leaf, lr))
+                for vi, vrl in enumerate(valid_rls):
+                    self.valid_scores[vi] = self.valid_scores[vi].at[k].set(
+                        self._update_score_jit(
+                            self.valid_scores[vi][k],
+                            tree_arrays.leaf_values, vrl, lr))
+            tree = Tree.from_device(host, self.train_set.bin_mappers,
+                                    self.train_set.used_features, shrink)
+            if self.iter_ == 0 and abs(self._init_scores[k]) > kEpsilon:
+                # AddBias (gbdt.cpp:416): fold init score into first tree
+                tree.leaf_value += self._init_scores[k]
+                tree.internal_value += self._init_scores[k]
+            self.models.append(tree)
+
+        if not should_continue and self.iter_ > 0:
+            # drop the no-op iteration, reference gbdt.cpp:441-447
+            for _ in range(self.K):
+                self.models.pop()
+            return True
+        self.iter_ += 1
+        return False
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self):
+        """RollbackOneIter (gbdt.cpp:454). Raises before mutating state —
+        full support needs per-tree leaf-assignment retention (planned)."""
+        raise NotImplementedError(
+            "rollback_one_iter requires per-tree partition retention; "
+            "planned alongside refit")
+
+    # ------------------------------------------------------------------
+    def eval_scores(self, which: int = -1) -> np.ndarray:
+        """Raw scores: which=-1 train, else valid index. [num_data, K]."""
+        if which < 0:
+            s = np.asarray(self.scores)[:, :self.train_dd.num_data]
+        else:
+            s = np.asarray(self.valid_scores[which]
+                           )[:, :self.valid_dd[which].num_data]
+        return s.T
+
+    def current_iteration(self) -> int:
+        return self.iter_
+
+    def num_trees(self) -> int:
+        return len(self.models)

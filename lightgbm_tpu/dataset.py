@@ -1,0 +1,214 @@
+"""Dataset: binned feature matrix + metadata, resident in HBM.
+
+TPU-native analog of the reference data layer (LightGBM
+``include/LightGBM/dataset.h:487`` ``Dataset``, ``dataset.h:48`` ``Metadata``,
+``src/io/dataset_loader.cpp`` ``DatasetLoader``).
+
+Design differences (TPU-first):
+- The reference stores per-feature-group packed columns (dense/sparse bins,
+  EFB bundles) tuned for CPU cache behavior. On TPU the histogram kernel
+  wants one dense row-major ``[num_data, num_features]`` bin matrix in HBM
+  (uint8 when max_bin <= 256) feeding the MXU one-hot matmul — sparse
+  storage would force gathers. EFB is unnecessary for the same reason.
+- Rows are padded to a multiple of the histogram row-block so every shape
+  under jit is static; padded rows carry ``row_leaf = -1`` and zero
+  grad/hess weight so they never contribute.
+- Binning runs on host NumPy over a sample (``bin_construct_sample_cnt``,
+  config.h analog) exactly like DatasetLoader's two-round sampling load.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from .binning import BinMapper
+from .config import Config
+
+__all__ = ["Dataset"]
+
+
+def _to_2d_float(data) -> np.ndarray:
+    if hasattr(data, "values") and hasattr(data, "columns"):  # DataFrame
+        arr = data.values
+    else:
+        arr = np.asarray(data)
+    if arr.ndim == 1:
+        arr = arr[:, None]
+    return np.ascontiguousarray(arr, dtype=np.float64)
+
+
+class Dataset:
+    """Binned training data.
+
+    Mirrors the construction flow of DatasetLoader::ConstructFromSampleData
+    (dataset_loader.cpp:593): sample rows -> fit BinMappers -> map all rows.
+    """
+
+    def __init__(self, data, label=None, weight=None, group=None,
+                 init_score=None, feature_name="auto",
+                 categorical_feature="auto", params: Optional[Dict] = None,
+                 reference: Optional["Dataset"] = None,
+                 free_raw_data: bool = True):
+        self.params = dict(params or {})
+        self.config = Config(self.params)
+        self._raw_data = data
+        self.label = None if label is None else np.asarray(
+            label, dtype=np.float64).reshape(-1)
+        self.weight = None if weight is None else np.asarray(
+            weight, dtype=np.float64).reshape(-1)
+        self.group = None if group is None else np.asarray(
+            group, dtype=np.int64).reshape(-1)
+        self.init_score = None if init_score is None else np.asarray(
+            init_score, dtype=np.float64)
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.reference = reference
+        self.free_raw_data = free_raw_data
+
+        self.bin_mappers: List[BinMapper] = []
+        self.bins: Optional[np.ndarray] = None      # [num_data, F] int
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.used_features: Optional[np.ndarray] = None  # indices of
+        # non-trivial features actually trained on
+        self._constructed = False
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._constructed:
+            return self
+        data = _to_2d_float(self._raw_data)
+        self.num_data, self.num_total_features = data.shape
+        cfg = self.config
+
+        if isinstance(self.feature_name, (list, tuple)) and self.feature_name:
+            names = list(self.feature_name)
+        elif hasattr(self._raw_data, "columns"):
+            names = [str(c) for c in self._raw_data.columns]
+        else:
+            names = [f"Column_{i}" for i in range(self.num_total_features)]
+        self.feature_name = names
+
+        cat_idx = self._resolve_categoricals(names)
+
+        if self.reference is not None:
+            # validation set: reuse the training bin mappers
+            # (dataset.h CreateValid / align-with-train semantics)
+            ref = self.reference.construct()
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.max_num_bin = ref.max_num_bin
+        else:
+            sample_cnt = min(cfg.bin_construct_sample_cnt, self.num_data)
+            if sample_cnt < self.num_data:
+                rng = np.random.RandomState(cfg.data_random_seed)
+                sample_idx = rng.choice(self.num_data, sample_cnt,
+                                        replace=False)
+                sample = data[sample_idx]
+            else:
+                sample = data
+            self.bin_mappers = []
+            for f in range(self.num_total_features):
+                bt = "categorical" if f in cat_idx else "numerical"
+                m = BinMapper.from_values(
+                    sample[:, f], max_bin=cfg.max_bin,
+                    min_data_in_bin=cfg.min_data_in_bin, bin_type=bt,
+                    use_missing=cfg.use_missing,
+                    zero_as_missing=cfg.zero_as_missing)
+                self.bin_mappers.append(m)
+            self.used_features = np.asarray(
+                [f for f, m in enumerate(self.bin_mappers)
+                 if not m.is_trivial], dtype=np.int32)
+            if len(self.used_features) == 0:
+                raise ValueError("Cannot construct Dataset: all features are "
+                                 "trivial (single value)")
+            self.max_num_bin = max(
+                self.bin_mappers[f].num_bin for f in self.used_features)
+
+        F = len(self.used_features)
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.int32
+        self.bins = np.empty((self.num_data, F), dtype=dtype)
+        for j, f in enumerate(self.used_features):
+            self.bins[:, j] = self.bin_mappers[f].values_to_bins(
+                data[:, f]).astype(dtype)
+
+        if self.label is None and not self.params.get("_allow_no_label"):
+            raise ValueError("Dataset has no label")
+        if self.free_raw_data:
+            self._raw_data = None
+        self._constructed = True
+        return self
+
+    def _resolve_categoricals(self, names) -> set:
+        cat = self.categorical_feature
+        if cat == "auto" or cat is None:
+            cfg_cat = self.config.categorical_feature
+            if not cfg_cat:
+                return set()
+            cat = [tok for tok in str(cfg_cat).split(",") if tok]
+        out = set()
+        for c in cat:
+            if isinstance(c, str) and not c.lstrip("-").isdigit():
+                if c in names:
+                    out.add(names.index(c))
+            else:
+                out.add(int(c))
+        return out
+
+    # ------------------------------------------------------------------
+    # accessors used by the trainer
+    @property
+    def num_features(self) -> int:
+        return len(self.used_features)
+
+    def per_feature_num_bins(self) -> np.ndarray:
+        return np.asarray([self.bin_mappers[f].num_bin
+                           for f in self.used_features], dtype=np.int32)
+
+    def per_feature_nan_bins(self) -> np.ndarray:
+        """nan bin index per used feature; -1 when the feature has none."""
+        return np.asarray([self.bin_mappers[f].nan_bin
+                           for f in self.used_features], dtype=np.int32)
+
+    def per_feature_is_categorical(self) -> np.ndarray:
+        return np.asarray(
+            [self.bin_mappers[f].bin_type == "categorical"
+             for f in self.used_features], dtype=bool)
+
+    def get_label(self):
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def query_boundaries(self) -> Optional[np.ndarray]:
+        """Cumulative query boundaries from per-query sizes (Metadata
+        query_boundaries_, dataset.h:48)."""
+        if self.group is None:
+            return None
+        return np.concatenate([[0], np.cumsum(self.group)]).astype(np.int64)
+
+    def set_field(self, name, value):
+        if name == "label":
+            self.label = np.asarray(value, dtype=np.float64).reshape(-1)
+        elif name == "weight":
+            self.weight = None if value is None else np.asarray(
+                value, dtype=np.float64).reshape(-1)
+        elif name == "group":
+            self.group = None if value is None else np.asarray(
+                value, dtype=np.int64).reshape(-1)
+        elif name == "init_score":
+            self.init_score = None if value is None else np.asarray(
+                value, dtype=np.float64)
+        else:
+            raise ValueError(f"Unknown field {name}")
+
+    def __len__(self):
+        return self.num_data

@@ -1,0 +1,531 @@
+"""Booster + train()/cv() — the user-facing training entry points.
+
+Analog of the reference Python package (``python-package/lightgbm/
+engine.py:109`` ``train``, ``engine.py:354,625`` ``CVBooster``/``cv``;
+``basic.py:3586`` ``Booster``). There is no C-API boundary here: the
+Booster drives the JAX GBDT directly (SURVEY.md §7.7 — Python-first API,
+no ctypes).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, \
+    Union
+
+import numpy as np
+
+from .boosting.gbdt import GBDT
+from .callback import CallbackEnv, EarlyStopException
+from .config import Config
+from .dataset import Dataset
+from .metrics import create_metrics, Metric
+from .objectives import create_objective, Objective
+from .tree import Tree
+
+__all__ = ["Booster", "train", "cv", "CVBooster"]
+
+
+class Booster:
+    """Trained/trainable model handle (basic.py:3586 analog)."""
+
+    def __init__(self, params: Optional[Dict] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._valid_names: List[str] = []
+        self._gbdt: Optional[GBDT] = None
+        self._trees: List[Tree] = []
+        self._num_class = 1
+        self._objective_name = "regression"
+        self._feature_names: List[str] = []
+        self._feature_infos: List[str] = []
+        self._max_feature_idx = 0
+        self._metrics: List[Metric] = []
+        self._train_metrics_data = None
+
+        if model_file is not None:
+            with open(model_file) as f:
+                self._load_from_string(f.read())
+            return
+        if model_str is not None:
+            self._load_from_string(model_str)
+            return
+        if train_set is None:
+            raise ValueError("Booster needs train_set, model_file or "
+                             "model_str")
+        if not isinstance(train_set, Dataset):
+            raise TypeError("train_set should be a Dataset instance")
+
+        self.config = Config(self.params)
+        train_set.params = {**self.params, **train_set.params}
+        train_set.construct()
+        self._objective: Optional[Objective] = create_objective(self.config)
+        self._objective_name = (self._objective.name if self._objective
+                                else "custom")
+        self._num_class = self.config.num_class
+        self.train_set = train_set
+        self._valid_sets: List[Dataset] = []
+        self._metrics = create_metrics(self.config)
+        self._feature_names = list(train_set.feature_name)
+        self._max_feature_idx = train_set.num_total_features - 1
+
+    # -- training ------------------------------------------------------
+    def _ensure_gbdt(self):
+        if self._gbdt is None:
+            self._gbdt = GBDT(self.config, self.train_set, self._objective,
+                              self._valid_sets)
+            self._trees = self._gbdt.models
+            for m in self._metrics:
+                m.init(self.train_set.get_label(),
+                       self.train_set.get_weight(),
+                       self.train_set.query_boundaries())
+            self._valid_metrics = []
+            for vs in self._valid_sets:
+                ms = create_metrics(self.config)
+                for m in ms:
+                    m.init(vs.get_label(), vs.get_weight(),
+                           vs.query_boundaries())
+                self._valid_metrics.append(ms)
+
+    def add_valid(self, data: Dataset, name: str):
+        if self._gbdt is not None:
+            raise RuntimeError("add_valid must be called before training "
+                               "starts (fixed-shape device state)")
+        data.reference = self.train_set
+        data.params = {**self.params, **data.params}
+        data.construct()
+        self._valid_sets.append(data)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set=None, fobj: Optional[Callable] = None) -> bool:
+        """One boosting iteration; True if stopped (no more splits)."""
+        self._ensure_gbdt()
+        if fobj is not None:
+            if self._objective is not None:
+                raise ValueError(
+                    "Custom objective requires objective='custom' in params "
+                    "(c_api LGBM_BoosterUpdateOneIterCustom contract)")
+            grad, hess = fobj(self._current_pred_for_fobj(), self.train_set)
+            return self._gbdt.train_one_iter(grad, hess)
+        return self._gbdt.train_one_iter()
+
+    def _current_pred_for_fobj(self):
+        return self._gbdt.eval_scores(-1).squeeze()
+
+    def reset_parameter(self, params: Dict):
+        self.params.update(params)
+        self.config.set(**params)
+        if self._gbdt is not None:
+            self._gbdt.shrinkage = self.config.learning_rate
+
+    # -- evaluation ----------------------------------------------------
+    def _converted(self, raw: np.ndarray) -> np.ndarray:
+        if self._objective is not None and self._objective.needs_convert:
+            return self._objective.convert_output(raw)
+        return raw
+
+    def eval_train(self, feval=None):
+        return self._eval_set(-1, "training", feval)
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i in range(len(self._valid_sets)):
+            out.extend(self._eval_set(i, self._valid_names[i], feval))
+        return out
+
+    def _eval_set(self, which: int, name: str, feval=None):
+        self._ensure_gbdt()
+        raw = self._gbdt.eval_scores(which)
+        if raw.shape[1] == 1:
+            raw = raw[:, 0]
+        pred = self._converted(raw)
+        metrics = self._metrics if which < 0 else self._valid_metrics[which]
+        out = []
+        for m in metrics:
+            for mname, value, bigger in m.eval(np.asarray(pred, np.float64)):
+                out.append((name, mname, value, bigger))
+        if feval is not None:
+            ds = self.train_set if which < 0 else self._valid_sets[which]
+            for fm in (feval if isinstance(feval, list) else [feval]):
+                res = fm(raw, ds)
+                if isinstance(res, list):
+                    for mname, value, bigger in res:
+                        out.append((name, mname, value, bigger))
+                else:
+                    mname, value, bigger = res
+                    out.append((name, mname, value, bigger))
+        return out
+
+    # -- prediction ----------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        """Batch prediction on raw features
+        (gbdt_prediction.cpp / predictor.hpp analog)."""
+        X = self._as_matrix(data)
+        if X.shape[1] != self._max_feature_idx + 1 and not (
+                kwargs.get("predict_disable_shape_check")
+                or self.params.get("predict_disable_shape_check")):
+            raise ValueError(
+                f"The number of features in data ({X.shape[1]}) is not the "
+                f"same as it was in training data "
+                f"({self._max_feature_idx + 1}).\nYou can set "
+                "predict_disable_shape_check=true to discard this error")
+        K = max(1, self._num_class)
+        trees = self._trees
+        if num_iteration is None or num_iteration < 0:
+            num_iteration = (self.best_iteration
+                             if self.best_iteration > 0 else
+                             len(trees) // K)
+        lo = start_iteration * K
+        hi = min(len(trees), (start_iteration + num_iteration) * K)
+        use = trees[lo:hi]
+        if pred_leaf:
+            out = np.stack([t.predict_leaf_index(X) for t in use], axis=1)
+            return out
+        if pred_contrib:
+            raise NotImplementedError(
+                "SHAP contributions are planned (tree.h:141 parity item)")
+        raw = np.zeros((X.shape[0], K))
+        for i, t in enumerate(use):
+            raw[:, (lo + i) % K] += t.predict(X)
+        if K == 1:
+            raw = raw[:, 0]
+        if raw_score:
+            return raw
+        return self._converted(raw)
+
+    def _as_matrix(self, data) -> np.ndarray:
+        if isinstance(data, Dataset):
+            raise TypeError("Cannot predict on a Dataset; pass the raw "
+                            "matrix (reference basic.py behavior)")
+        if hasattr(data, "values") and hasattr(data, "columns"):
+            data = data.values
+        arr = np.asarray(data, dtype=np.float64)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        return arr
+
+    # -- model IO (gbdt_model_text.cpp analog) -------------------------
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        K = max(1, self._num_class)
+        trees = self._trees
+        if num_iteration is not None and num_iteration > 0:
+            trees = trees[: num_iteration * K]
+        header = [
+            "tree",
+            "version=v4",
+            f"num_class={self._num_class}",
+            f"num_tree_per_iteration={K}",
+            "label_index=0",
+            f"max_feature_idx={self._max_feature_idx}",
+            f"objective={self._objective_text()}",
+            "feature_names=" + " ".join(self._feature_names),
+            "feature_infos=" + " ".join(self._feature_infos_list()),
+            "",
+        ]
+        blocks = [t.to_text(i) for i, t in enumerate(trees)]
+        sizes = [len(b.encode()) + 1 for b in blocks]
+        header.insert(-1, "tree_sizes=" + " ".join(str(s) for s in sizes))
+        body = "\n".join(blocks)
+        tail = ["", "end of trees", ""]
+        imp = self.feature_importance(importance_type)
+        order = np.argsort(-imp, kind="stable")
+        tail.append("feature_importances:")
+        for i in order:
+            if imp[i] > 0:
+                tail.append(f"{self._feature_names[i]}={imp[i]:g}")
+        tail += ["", "parameters:"]
+        for key, val in sorted(self.params.items()):
+            tail.append(f"[{key}: {val}]")
+        tail += ["end of parameters", "", "pandas_categorical:null", ""]
+        return "\n".join(header) + "\n" + body + "\n".join(tail)
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split"):
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration,
+                                         importance_type))
+        return self
+
+    def model_from_string(self, model_str: str):
+        self._load_from_string(model_str)
+        return self
+
+    def _objective_text(self) -> str:
+        name = self._objective_name
+        if name == "binary":
+            return f"binary sigmoid:{Config(self.params).sigmoid:g}"
+        if name in ("multiclass", "multiclassova"):
+            return f"{name} num_class:{self._num_class}"
+        if name == "lambdarank":
+            return "lambdarank"
+        return name
+
+    def _feature_infos_list(self) -> List[str]:
+        if self._feature_infos:
+            return self._feature_infos
+        if hasattr(self, "train_set") and self.train_set._constructed:
+            return [m.feature_info_str()
+                    for m in self.train_set.bin_mappers]
+        return ["none"] * (self._max_feature_idx + 1)
+
+    def _load_from_string(self, s: str):
+        lines = s.splitlines()
+        header: Dict[str, str] = {}
+        i = 0
+        while i < len(lines) and not lines[i].startswith("Tree="):
+            ln = lines[i]
+            if "=" in ln:
+                k, v = ln.split("=", 1)
+                header[k] = v
+            i += 1
+        self._num_class = int(header.get("num_class", "1"))
+        self._max_feature_idx = int(header.get("max_feature_idx", "0"))
+        obj = header.get("objective", "regression").split()
+        self._objective_name = obj[0] if obj else "regression"
+        self._feature_names = header.get("feature_names", "").split()
+        self._feature_infos = header.get("feature_infos", "").split()
+        self.params.setdefault("objective", self._objective_name)
+        if self._num_class > 1:
+            self.params["num_class"] = self._num_class
+        self.config = Config({k: v for k, v in self.params.items()})
+        self._objective = create_objective(self.config) \
+            if self._objective_name != "custom" else None
+        # split tree blocks
+        rest = "\n".join(lines[i:])
+        blocks = rest.split("Tree=")[1:]
+        trees = []
+        for b in blocks:
+            b = b.split("end of trees")[0]
+            trees.append(Tree.from_text("Tree=" + b))
+        self._trees = trees
+
+    # -- introspection -------------------------------------------------
+    def num_trees(self) -> int:
+        return len(self._trees)
+
+    def current_iteration(self) -> int:
+        return len(self._trees) // max(1, self._num_class)
+
+    def num_feature(self) -> int:
+        return self._max_feature_idx + 1
+
+    def feature_name(self) -> List[str]:
+        return list(self._feature_names)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        nf = self._max_feature_idx + 1
+        out = np.zeros(nf)
+        for t in self._trees:
+            if importance_type == "gain":
+                out += t.feature_importance_gain(nf)
+            else:
+                out += t.feature_importance_split(nf)
+        return out
+
+    def free_dataset(self):
+        return self
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo):
+        return Booster(model_str=self.model_to_string(),
+                       params=dict(self.params))
+
+
+def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+          valid_sets: Optional[Sequence[Dataset]] = None,
+          valid_names: Optional[Sequence[str]] = None,
+          feval=None, init_model=None, keep_training_booster: bool = False,
+          callbacks: Optional[Sequence[Callable]] = None,
+          fobj=None) -> Booster:
+    """Main training loop (engine.py:109 analog)."""
+    params = dict(params or {})
+    cfg = Config(params)
+    if "num_iterations" in cfg.explicit():  # any registered alias resolves
+        num_boost_round = cfg.num_iterations
+    if callable(params.get("objective")):
+        fobj = params["objective"]
+        params["objective"] = "custom"
+
+    booster = Booster(params=params, train_set=train_set)
+    if valid_sets:
+        valid_names = list(valid_names or [])
+        for i, vs in enumerate(valid_sets):
+            if vs is train_set:
+                continue  # training data is evaluated anyway
+            name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+            booster.add_valid(vs, name)
+
+    callbacks = list(callbacks or [])
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        from .callback import early_stopping
+        callbacks.append(early_stopping(
+            cfg.early_stopping_round,
+            first_metric_only=cfg.first_metric_only,
+            min_delta=cfg.early_stopping_min_delta))
+    if cfg.verbosity >= 1 and not any(
+            getattr(cb, "order", None) == 10 and
+            not getattr(cb, "before_iteration", False)
+            for cb in callbacks):
+        pass  # reference only logs when log_evaluation is requested
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        env_before = CallbackEnv(booster, params, i, 0, num_boost_round, None)
+        for cb in callbacks_before:
+            cb(env_before)
+        stop = booster.update(fobj=fobj)
+        evals = []
+        need_eval = bool(callbacks_after) or cfg.early_stopping_round > 0
+        if need_eval:
+            if cfg.is_provide_training_metric:
+                evals.extend(booster.eval_train(feval))
+            evals.extend(booster.eval_valid(feval))
+        env = CallbackEnv(booster, params, i, 0, num_boost_round, evals)
+        try:
+            for cb in callbacks_after:
+                cb(env)
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for name, metric, value, _ in (e.best_score or []):
+                booster.best_score.setdefault(name, {})[metric] = value
+            break
+        if stop:
+            break
+    return booster
+
+
+class CVBooster:
+    """Container of per-fold boosters (engine.py:354 analog)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster):
+        self.boosters.append(booster)
+
+    def __getattr__(self, name):
+        def handler(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return handler
+
+
+def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """K-fold cross-validation (engine.py:625 analog)."""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    train_set.construct()
+    label = train_set.get_label()
+    n = train_set.num_data
+    rng = np.random.RandomState(seed)
+
+    weight = train_set.get_weight()
+    group = train_set.get_group()
+    init_score = train_set.get_init_score()
+
+    if folds is None:
+        if group is not None:
+            # group-aware folds: split whole queries (engine.py _make_n_folds
+            # uses GroupKFold semantics for ranking)
+            qb = train_set.query_boundaries()
+            qidx = np.arange(len(group))
+            if shuffle:
+                rng.shuffle(qidx)
+            qparts = np.array_split(qidx, nfold)
+            folds = []
+            for f in range(nfold):
+                te_q = np.sort(qparts[f])
+                te = np.concatenate([np.arange(qb[q], qb[q + 1])
+                                     for q in te_q])
+                folds.append((np.setdiff1d(np.arange(n), te), te))
+        elif stratified and Config(params).objective in ("binary",
+                                                         "multiclass",
+                                                         "multiclassova"):
+            idx = np.arange(n)
+            folds_idx = [[] for _ in range(nfold)]
+            for cls in np.unique(label):
+                ci = idx[label == cls]
+                if shuffle:
+                    rng.shuffle(ci)
+                for f in range(nfold):
+                    folds_idx[f].extend(ci[f::nfold])
+            folds = [(np.setdiff1d(idx, np.asarray(te)), np.asarray(te))
+                     for te in folds_idx]
+        else:
+            idx = np.arange(n)
+            if shuffle:
+                rng.shuffle(idx)
+            parts = np.array_split(idx, nfold)
+            folds = [(np.concatenate([parts[j] for j in range(nfold)
+                                      if j != f]), parts[f])
+                     for f in range(nfold)]
+
+    raw = train_set._raw_data
+    if raw is None:
+        raise ValueError("cv requires train_set with free_raw_data=False")
+    X = np.asarray(raw, dtype=np.float64)
+
+    def _group_sizes(row_idx):
+        if group is None:
+            return None
+        qb = train_set.query_boundaries()
+        qid = np.searchsorted(qb, row_idx, side="right") - 1
+        _, sizes = np.unique(qid, return_counts=True)
+        return sizes
+
+    results: Dict[str, List[float]] = {}
+    cvb = CVBooster()
+    fold_histories = []
+    for tr_idx, te_idx in folds:
+        dtrain = Dataset(X[tr_idx], label=label[tr_idx],
+                         weight=None if weight is None else weight[tr_idx],
+                         group=_group_sizes(tr_idx),
+                         init_score=None if init_score is None
+                         else init_score[tr_idx],
+                         params=dict(train_set.params))
+        dvalid = Dataset(X[te_idx], label=label[te_idx],
+                         weight=None if weight is None else weight[te_idx],
+                         group=_group_sizes(te_idx),
+                         init_score=None if init_score is None
+                         else init_score[te_idx], reference=dtrain)
+        from .callback import record_evaluation
+        hist: Dict = {}
+        cbs = list(callbacks or []) + [record_evaluation(hist)]
+        bst = train(params, dtrain, num_boost_round, valid_sets=[dvalid],
+                    valid_names=["valid"], callbacks=cbs)
+        cvb.append(bst)
+        fold_histories.append(hist.get("valid", {}))
+    # aggregate
+    for metric in (fold_histories[0] or {}):
+        rounds = min(len(h[metric]) for h in fold_histories)
+        vals = np.asarray([h[metric][:rounds] for h in fold_histories])
+        results[f"valid {metric}-mean"] = vals.mean(axis=0).tolist()
+        results[f"valid {metric}-stdv"] = vals.std(axis=0).tolist()
+    if return_cvbooster:
+        results["cvbooster"] = cvb
+    return results

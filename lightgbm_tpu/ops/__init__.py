@@ -1,0 +1,1 @@
+"""Compute ops: histogram, split finding, prediction kernels."""

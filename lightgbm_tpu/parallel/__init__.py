@@ -1,0 +1,1 @@
+"""Distributed training: mesh setup, sharded training step."""

@@ -1,0 +1,178 @@
+"""Ranking objectives: LambdaRank and XE-NDCG.
+
+TPU-native analog of the reference ranking objectives
+(``src/objective/rank_objective.hpp``: ``LambdarankNDCG``,
+``RankXENDCG``).
+
+Design (TPU-first): the reference loops per query over doc pairs with
+OpenMP. Here queries are padded into a dense ``[num_queries, max_query]``
+index matrix once at init; gradients are a vmapped per-query kernel over
+that lattice — pairwise [S, S] tensors on the VPU, no data-dependent
+shapes. Padded lanes carry zero weight.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .objectives import Objective
+
+__all__ = ["LambdaRank", "RankXENDCG"]
+
+
+def _build_query_index(query_boundaries: np.ndarray):
+    """[Q, S] row-index matrix (-1 pad) from cumulative boundaries."""
+    sizes = np.diff(query_boundaries)
+    Q = len(sizes)
+    S = int(sizes.max())
+    idx = np.full((Q, S), -1, dtype=np.int32)
+    for q in range(Q):
+        lo, hi = query_boundaries[q], query_boundaries[q + 1]
+        idx[q, : hi - lo] = np.arange(lo, hi, dtype=np.int32)
+    return idx
+
+
+class _RankingBase(Objective):
+    is_ranking = True
+
+    def init(self, label, weight, query_boundaries=None):
+        if query_boundaries is None:
+            raise ValueError(
+                f"{self.name} objective requires query/group information")
+        super().init(label, weight, query_boundaries)
+        self.query_index = _build_query_index(np.asarray(query_boundaries))
+
+    def scatter_from_queries(self, per_query, idx, num_rows):
+        """[Q, S] -> [R]; each row appears in exactly one query slot."""
+        flat_idx = jnp.where(idx >= 0, idx, num_rows).reshape(-1)
+        out = jnp.zeros((num_rows + 1,), per_query.dtype)
+        out = out.at[flat_idx].set(per_query.reshape(-1))
+        return out[:num_rows]
+
+
+class LambdaRank(_RankingBase):
+    """LambdaMART gradients with NDCG deltas
+    (rank_objective.hpp LambdarankNDCG)."""
+
+    name = "lambdarank"
+
+    def init(self, label, weight, query_boundaries=None):
+        super().init(label, weight, query_boundaries)
+        cfg = self.cfg
+        max_label = int(np.max(label)) if len(label) else 0
+        lg = list(cfg.label_gain)
+        if not lg:
+            # default label gain: 2^i - 1 (config.h label_gain default)
+            lg = [(1 << i) - 1 for i in range(max(max_label + 1, 2))]
+        if max_label >= len(lg):
+            raise ValueError("label_gain table shorter than max label")
+        self.label_gain = np.asarray(lg, dtype=np.float64)
+        self.trunc = int(cfg.lambdarank_truncation_level)
+        self.norm = bool(cfg.lambdarank_norm)
+        self.sig = float(cfg.sigmoid)
+        # per-query inverse max DCG at truncation (DCGCalculator analog)
+        qb = np.asarray(query_boundaries)
+        inv = np.zeros(len(qb) - 1)
+        for q in range(len(qb) - 1):
+            lab = label[qb[q]:qb[q + 1]]
+            gains = self.label_gain[lab.astype(np.int64)]
+            top = np.sort(gains)[::-1][: self.trunc]
+            dcg = np.sum(top / np.log2(np.arange(2, 2 + len(top))))
+            inv[q] = 1.0 / dcg if dcg > 0 else 0.0
+        self.inverse_max_dcg = inv
+
+    def get_gradients(self, score, label, weight, it=None):
+        idx = jnp.asarray(self.query_index)
+        inv_mdcg = jnp.asarray(self.inverse_max_dcg, dtype=score.dtype)
+        lg = jnp.asarray(self.label_gain, dtype=score.dtype)
+        sig, trunc, norm = self.sig, self.trunc, self.norm
+        R = score.shape[0]
+
+        s_q = jnp.where(idx >= 0, score[jnp.clip(idx, 0)], -jnp.inf)
+        y_q = jnp.where(idx >= 0, label[jnp.clip(idx, 0)].astype(jnp.int32),
+                        -1)
+        mask_q = idx >= 0
+
+        def per_query(s, y, mask, inv):
+            S = s.shape[0]
+            # rank of each doc by score desc (padded lanes sink to the end);
+            # ties broken by position like the reference's stable sort
+            order = jnp.argsort(-jnp.where(mask, s, -jnp.inf),
+                                stable=True)
+            rank = jnp.zeros((S,), jnp.int32).at[order].set(
+                jnp.arange(S, dtype=jnp.int32))
+            gain = jnp.where(mask, lg[jnp.clip(y, 0)], 0.0)
+            disc = jnp.where((rank < trunc) & mask,
+                             1.0 / jnp.log2(2.0 + rank.astype(s.dtype)), 0.0)
+            # pair (i, j): considered when y_i != y_j and at least one of
+            # the two sits inside the truncation window
+            dy = y[:, None] - y[None, :]
+            pair = (dy > 0) & mask[:, None] & mask[None, :]
+            pair &= (rank[:, None] < trunc) | (rank[None, :] < trunc)
+            dgain = gain[:, None] - gain[None, :]
+            ddisc = disc[:, None] - disc[None, :]
+            delta = jnp.abs(dgain * ddisc) * inv
+            ds = s[:, None] - s[None, :]
+            rho = 1.0 / (1.0 + jnp.exp(sig * ds))     # P(j beats i)
+            lam = sig * rho * delta                   # |lambda| toward i up
+            hes = sig * sig * rho * (1.0 - rho) * delta
+            lam = jnp.where(pair, lam, 0.0)
+            hes = jnp.where(pair, hes, 0.0)
+            g = -lam.sum(axis=1) + lam.sum(axis=0)    # i gains, j loses
+            h = hes.sum(axis=1) + hes.sum(axis=0)
+            if norm:
+                sum_lam = lam.sum()
+                nf = jnp.where(sum_lam > 0,
+                               jnp.log2(1.0 + sum_lam) / sum_lam, 1.0)
+                g, h = g * nf, h * nf
+            return g, h
+
+        g_q, h_q = jax.vmap(per_query)(s_q, y_q, mask_q, inv_mdcg)
+        g = self.scatter_from_queries(g_q, idx, R)
+        h = self.scatter_from_queries(h_q, idx, R)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h
+
+
+class RankXENDCG(_RankingBase):
+    """Cross-entropy NDCG surrogate (rank_objective.hpp RankXENDCG)."""
+
+    name = "rank_xendcg"
+
+    def init(self, label, weight, query_boundaries=None):
+        super().init(label, weight, query_boundaries)
+        self.seed = int(self.cfg.objective_seed)
+
+    def get_gradients(self, score, label, weight, it=None):
+        idx = jnp.asarray(self.query_index)
+        R = score.shape[0]
+        if it is None:
+            it = jnp.asarray(0, jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), it)
+
+        s_q = jnp.where(idx >= 0, score[jnp.clip(idx, 0)], -jnp.inf)
+        y_q = jnp.where(idx >= 0, label[jnp.clip(idx, 0)], 0.0)
+        mask_q = idx >= 0
+        gam = jax.random.uniform(key, s_q.shape, dtype=score.dtype)
+
+        def per_query(s, y, mask, gamma):
+            rho = jax.nn.softmax(jnp.where(mask, s, -jnp.inf))
+            rho = jnp.where(mask, rho, 0.0)
+            phi = jnp.where(mask, jnp.exp2(y) - gamma, 0.0)
+            denom = jnp.maximum(phi.sum(), 1e-20)
+            p = phi / denom
+            g = rho - p
+            h = jnp.maximum(rho * (1.0 - rho), 1e-16)
+            return jnp.where(mask, g, 0.0), jnp.where(mask, h, 0.0)
+
+        g_q, h_q = jax.vmap(per_query)(s_q, y_q, mask_q, gam)
+        g = self.scatter_from_queries(g_q, idx, R)
+        h = self.scatter_from_queries(h_q, idx, R)
+        if weight is not None:
+            g, h = g * weight, h * weight
+        return g, h

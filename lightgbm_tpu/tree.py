@@ -1,0 +1,287 @@
+"""Host-side tree model + LightGBM-v4-compatible text serialization.
+
+Analog of the reference ``include/LightGBM/tree.h`` / ``src/io/tree.cpp``
+(SoA node arrays, text round-trip at tree.cpp:339,697) and the per-tree
+blocks of ``src/boosting/gbdt_model_text.cpp``.
+
+The on-device tree (boosting/tree_builder.TreeArrays) uses flat node ids;
+this module renumbers into the reference's scheme — internal nodes by split
+order, leaves by leaf slot, children encoded as ``node_idx`` or ``~leaf_idx``
+— so saved models are loadable by stock LightGBM tooling and vice versa.
+
+decision_type bit layout (tree.h): bit0 = categorical, bit1 = default_left,
+bits 2-3 = missing_type (0 none / 1 zero / 2 nan).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from typing import Dict, List, Optional
+
+from .binning import MISSING_NONE, MISSING_ZERO, MISSING_NAN
+
+__all__ = ["Tree"]
+
+_CAT_BIT = 1
+_DEFAULT_LEFT_BIT = 2
+_MISSING_SHIFT = 2  # bits 2-3 after the two flags
+
+
+def _missing_from_decision(dt: int) -> int:
+    return (dt >> _MISSING_SHIFT) & 3
+
+
+class Tree:
+    """One decision tree in reference numbering (host, NumPy)."""
+
+    def __init__(self, num_leaves: int):
+        self.num_leaves = num_leaves
+        n_int = max(num_leaves - 1, 0)
+        self.split_feature = np.zeros(n_int, np.int32)
+        self.threshold = np.zeros(n_int, np.float64)      # real-valued
+        self.threshold_bin = np.zeros(n_int, np.int32)    # for binned predict
+        self.decision_type = np.zeros(n_int, np.int32)
+        self.split_gain = np.zeros(n_int, np.float64)
+        self.left_child = np.zeros(n_int, np.int32)
+        self.right_child = np.zeros(n_int, np.int32)
+        self.internal_value = np.zeros(n_int, np.float64)
+        self.internal_weight = np.zeros(n_int, np.float64)
+        self.internal_count = np.zeros(n_int, np.int64)
+        self.leaf_value = np.zeros(num_leaves, np.float64)
+        self.leaf_weight = np.zeros(num_leaves, np.float64)
+        self.leaf_count = np.zeros(num_leaves, np.int64)
+        self.shrinkage = 1.0
+        # categorical split storage (tree.h cat_boundaries_/cat_threshold_)
+        self.num_cat = 0
+        self.cat_boundaries = [0]
+        self.cat_threshold: List[int] = []
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_device(cls, t, bin_mappers, used_features,
+                    shrinkage: float) -> "Tree":
+        """Convert a tree_builder.TreeArrays pytree (host numpy'd)."""
+        num_leaves = int(t.num_leaves)
+        num_nodes = int(t.num_nodes)
+        tree = cls(num_leaves)
+        tree.shrinkage = shrinkage
+
+        sf = np.asarray(t.split_feature)[:num_nodes]
+        internal_nodes = np.nonzero(sf >= 0)[0]
+        # split order == creation order of children (node ids are assigned
+        # monotonically per split)
+        lc = np.asarray(t.left_child)[:num_nodes]
+        order = np.argsort(lc[internal_nodes], kind="stable")
+        internal_nodes = internal_nodes[order]
+        int_idx = {int(n): i for i, n in enumerate(internal_nodes)}
+
+        leaf2node = np.asarray(t.leaf2node)[:num_leaves]
+        leaf_idx = {int(n): s for s, n in enumerate(leaf2node)}
+
+        if num_leaves == 1:
+            tree.leaf_value[0] = float(np.asarray(t.node_value)[0]) * shrinkage
+            tree.leaf_weight[0] = float(np.asarray(t.node_hess)[0])
+            tree.leaf_count[0] = int(np.asarray(t.node_count)[0])
+            return tree
+
+        thrb = np.asarray(t.threshold_bin)
+        dl = np.asarray(t.default_left)
+        cat = np.asarray(t.is_cat)
+        rc = np.asarray(t.right_child)
+        gain = np.asarray(t.gain)
+        val = np.asarray(t.node_value)
+        cnt = np.asarray(t.node_count)
+        hes = np.asarray(t.node_hess)
+
+        for i, n in enumerate(internal_nodes):
+            f_local = int(sf[n])
+            f_global = int(used_features[f_local])
+            mapper = bin_mappers[f_global]
+            tree.split_feature[i] = f_global
+            tree.threshold_bin[i] = int(thrb[n])
+            dt = 0
+            if cat[n]:
+                dt |= _CAT_BIT
+                tree.threshold[i] = tree.num_cat  # index into cat storage
+                tree._append_cat_bitset(
+                    [int(mapper.categories[int(thrb[n])])])
+            else:
+                dt |= (mapper.missing_type & 3) << _MISSING_SHIFT
+                if dl[n]:
+                    dt |= _DEFAULT_LEFT_BIT
+                tree.threshold[i] = mapper.bin_to_threshold_value(
+                    int(thrb[n]))
+            tree.decision_type[i] = dt
+            tree.split_gain[i] = float(gain[n])
+            tree.internal_value[i] = float(val[n]) * shrinkage
+            tree.internal_weight[i] = float(hes[n])
+            tree.internal_count[i] = int(cnt[n])
+            for child_arr, out in ((lc, tree.left_child),
+                                   (rc, tree.right_child)):
+                c = int(child_arr[n])
+                out[i] = int_idx[c] if c in int_idx else ~leaf_idx[c]
+
+        for s in range(num_leaves):
+            n = int(leaf2node[s])
+            tree.leaf_value[s] = float(val[n]) * shrinkage
+            tree.leaf_weight[s] = float(hes[n])
+            tree.leaf_count[s] = int(cnt[n])
+        return tree
+
+    def _append_cat_bitset(self, categories: List[int]):
+        """Append one categorical split's bitset (tree.cpp cat storage)."""
+        maxc = max(categories)
+        nwords = maxc // 32 + 1
+        words = [0] * nwords
+        for c in categories:
+            words[c // 32] |= (1 << (c % 32))
+        self.cat_threshold.extend(words)
+        self.cat_boundaries.append(len(self.cat_threshold))
+        self.num_cat += 1
+
+    # ------------------------------------------------------------------
+    def _traverse(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized raw-feature traversal (tree.h Predict decision path);
+        returns the leaf index per row."""
+        n = X.shape[0]
+        if self.num_leaves == 1:
+            return np.zeros(n, np.int32)
+        node = np.zeros(n, np.int32)       # >=0: internal idx; <0: ~leaf
+        active = np.ones(n, bool)
+        out = np.zeros(n, np.int32)
+        for _ in range(self.num_leaves):   # depth bound
+            if not active.any():
+                break
+            idx = node[active]
+            f = self.split_feature[idx]
+            v = X[active, f]
+            dt = self.decision_type[idx]
+            is_cat = (dt & _CAT_BIT) != 0
+            go_left = np.zeros(len(idx), bool)
+            # numerical
+            num = ~is_cat
+            vn = v[num]
+            nan = np.isnan(vn)
+            mt = _missing_from_decision(dt[num])
+            # missing none/zero: NaN treated as 0 (c_api predict semantics)
+            vn = np.where(nan & (mt != MISSING_NAN), 0.0, vn)
+            gl = vn <= self.threshold[idx[num]]
+            defl = (dt[num] & _DEFAULT_LEFT_BIT) != 0
+            gl = np.where(nan & (mt == MISSING_NAN), defl, gl)
+            go_left[num] = gl
+            # categorical: membership in bitset
+            if is_cat.any():
+                for j in np.nonzero(is_cat)[0]:
+                    cat_idx = int(self.threshold[idx[j]])
+                    lo = self.cat_boundaries[cat_idx]
+                    hi = self.cat_boundaries[cat_idx + 1]
+                    vv = v[j]
+                    if np.isnan(vv) or vv < 0:
+                        go_left[j] = False
+                    else:
+                        c = int(vv)
+                        w = c // 32
+                        go_left[j] = (w < hi - lo) and bool(
+                            (self.cat_threshold[lo + w] >> (c % 32)) & 1)
+            nxt = np.where(go_left, self.left_child[idx],
+                           self.right_child[idx])
+            node[active] = nxt
+            leaf_now = nxt < 0
+            act_idx = np.nonzero(active)[0]
+            done = act_idx[leaf_now]
+            out[done] = ~nxt[leaf_now]
+            active[done] = False
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        return self.leaf_value[self._traverse(X)]
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        return self._traverse(X)
+
+    # ------------------------------------------------------------------
+    def to_text(self, tree_id: int) -> str:
+        """One ``Tree=<id>`` block (gbdt_model_text.cpp:311 format)."""
+        def join(a, fmt="{}"):
+            if fmt == "{!r}":  # full-precision float round-trip
+                return " ".join(repr(float(x)) for x in a)
+            return " ".join(fmt.format(x) for x in a)
+
+        lines = [f"Tree={tree_id}",
+                 f"num_leaves={self.num_leaves}",
+                 f"num_cat={self.num_cat}"]
+        if self.num_leaves > 1:
+            lines += [
+                "split_feature=" + join(self.split_feature),
+                "split_gain=" + join(self.split_gain, "{:g}"),
+                "threshold=" + join(self.threshold, "{!r}").replace(
+                    "inf", "1.7976931348623157e+308"),
+                "decision_type=" + join(self.decision_type),
+                "left_child=" + join(self.left_child),
+                "right_child=" + join(self.right_child),
+                "leaf_value=" + join(self.leaf_value, "{!r}"),
+                "leaf_weight=" + join(self.leaf_weight, "{!r}"),
+                "leaf_count=" + join(self.leaf_count),
+                "internal_value=" + join(self.internal_value, "{!r}"),
+                "internal_weight=" + join(self.internal_weight, "{!r}"),
+                "internal_count=" + join(self.internal_count),
+            ]
+            if self.num_cat > 0:
+                lines += ["cat_boundaries=" + join(self.cat_boundaries),
+                          "cat_threshold=" + join(self.cat_threshold)]
+        else:
+            lines += ["leaf_value=" + join(self.leaf_value, "{!r}")]
+        lines += [f"is_linear=0", f"shrinkage={self.shrinkage:g}", ""]
+        return "\n".join(lines)
+
+    @classmethod
+    def from_text(cls, block: str) -> "Tree":
+        """Parse one Tree block (tree.cpp:697 Tree(const char*) analog)."""
+        kv: Dict[str, str] = {}
+        for line in block.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        num_leaves = int(kv["num_leaves"])
+        tree = cls(num_leaves)
+
+        def arr(key, dtype, n):
+            if key not in kv or not kv[key]:
+                return np.zeros(n, dtype)
+            return np.asarray(kv[key].split(), dtype=dtype)
+
+        tree.leaf_value = arr("leaf_value", np.float64, num_leaves)
+        if num_leaves > 1:
+            n_int = num_leaves - 1
+            tree.split_feature = arr("split_feature", np.int32, n_int)
+            tree.split_gain = arr("split_gain", np.float64, n_int)
+            tree.threshold = arr("threshold", np.float64, n_int)
+            tree.decision_type = arr("decision_type", np.int32, n_int)
+            tree.left_child = arr("left_child", np.int32, n_int)
+            tree.right_child = arr("right_child", np.int32, n_int)
+            tree.leaf_weight = arr("leaf_weight", np.float64, num_leaves)
+            tree.leaf_count = arr("leaf_count", np.int64, num_leaves)
+            tree.internal_value = arr("internal_value", np.float64, n_int)
+            tree.internal_weight = arr("internal_weight", np.float64, n_int)
+            tree.internal_count = arr("internal_count", np.int64, n_int)
+            tree.num_cat = int(kv.get("num_cat", "0"))
+            if tree.num_cat > 0:
+                tree.cat_boundaries = [int(x) for x in
+                                       kv["cat_boundaries"].split()]
+                tree.cat_threshold = [int(x) for x in
+                                      kv["cat_threshold"].split()]
+        tree.shrinkage = float(kv.get("shrinkage", "1"))
+        return tree
+
+    def num_nodes(self) -> int:
+        return 2 * self.num_leaves - 1
+
+    def feature_importance_split(self, num_features: int) -> np.ndarray:
+        out = np.zeros(num_features)
+        np.add.at(out, self.split_feature, 1.0)
+        return out
+
+    def feature_importance_gain(self, num_features: int) -> np.ndarray:
+        out = np.zeros(num_features)
+        np.add.at(out, self.split_feature, self.split_gain)
+        return out

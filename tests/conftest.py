@@ -1,0 +1,28 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Mirrors the reference's distributed-test strategy
+(tests/distributed/_test_distributed.py launches N CLI processes on
+localhost): here N virtual CPU devices stand in for TPU chips so sharding
+tests exercise real collectives without hardware.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# force CPU: the session env pins JAX_PLATFORMS to the TPU tunnel platform,
+# and the env var alone does not win against it — use the config API.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)

@@ -1,0 +1,113 @@
+"""BinMapper tests (reference semantics: bin.cpp FindBin/GreedyFindBin)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BinMapper, MISSING_NAN, MISSING_NONE,
+                                  MISSING_ZERO)
+
+
+def test_few_distinct_values_get_own_bins():
+    v = np.array([1.0, 2.0, 3.0, 1.0, 2.0, 3.0] * 10)
+    m = BinMapper.from_values(v, max_bin=255, min_data_in_bin=1)
+    b = m.values_to_bins(np.array([1.0, 2.0, 3.0]))
+    assert len(set(b.tolist())) == 3
+    # boundaries are midpoints: 1.4 binned with 1, 2.6 with 3
+    assert m.values_to_bins(np.array([1.4]))[0] == b[0]
+    assert m.values_to_bins(np.array([2.6]))[0] == b[2]
+
+
+def test_equal_count_binning():
+    rng = np.random.RandomState(0)
+    v = rng.normal(size=100_000)
+    m = BinMapper.from_values(v, max_bin=64, min_data_in_bin=3)
+    bins = m.values_to_bins(v)
+    counts = np.bincount(bins, minlength=m.num_bin)
+    assert m.num_bin <= 64
+    # roughly equal counts (within 3x of ideal for the nonzero bins)
+    nonzero = counts[counts > 0]
+    assert nonzero.min() > 0
+    assert nonzero.max() < 6 * 100_000 / m.num_bin
+
+
+def test_monotonic_mapping():
+    rng = np.random.RandomState(1)
+    v = rng.uniform(-5, 5, size=10_000)
+    m = BinMapper.from_values(v, max_bin=32)
+    x = np.sort(rng.uniform(-5, 5, size=1000))
+    b = m.values_to_bins(x)
+    assert (np.diff(b) >= 0).all()
+
+
+def test_nan_gets_last_bin():
+    v = np.array([1.0, 2.0, np.nan, 3.0, np.nan] * 20)
+    m = BinMapper.from_values(v, max_bin=16)
+    assert m.missing_type == MISSING_NAN
+    assert m.nan_bin == m.num_bin - 1
+    b = m.values_to_bins(np.array([np.nan, 1.0]))
+    assert b[0] == m.num_bin - 1
+    assert b[1] != m.num_bin - 1
+
+
+def test_zero_bin_dedicated():
+    v = np.concatenate([np.zeros(50), np.arange(1, 51), -np.arange(1, 51)])
+    m = BinMapper.from_values(v, max_bin=32)
+    zb = m.values_to_bins(np.array([0.0]))[0]
+    assert m.values_to_bins(np.array([1e-40]))[0] == zb
+    assert m.values_to_bins(np.array([1.0]))[0] != zb
+    assert m.values_to_bins(np.array([-1.0]))[0] != zb
+    assert m.default_bin == zb
+
+
+def test_zero_as_missing():
+    v = np.concatenate([np.zeros(50), np.arange(1, 51), [np.nan] * 5])
+    m = BinMapper.from_values(v, max_bin=32, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    zb = m.values_to_bins(np.array([0.0]))[0]
+    assert m.values_to_bins(np.array([np.nan]))[0] == zb
+
+
+def test_trivial_feature():
+    m = BinMapper.from_values(np.full(100, 7.0), max_bin=32)
+    assert m.is_trivial
+
+
+def test_max_bin_respected_many_distinct():
+    rng = np.random.RandomState(2)
+    v = rng.normal(size=50_000)
+    for mb in (16, 63, 255):
+        m = BinMapper.from_values(v, max_bin=mb)
+        assert m.num_bin <= mb
+        assert m.values_to_bins(v).max() < m.num_bin
+
+
+def test_heavy_hitter_own_bin():
+    v = np.concatenate([np.full(10_000, 5.0),
+                        np.random.RandomState(3).normal(size=1000)])
+    m = BinMapper.from_values(v, max_bin=8)
+    b5 = m.values_to_bins(np.array([5.0]))[0]
+    bins = m.values_to_bins(v)
+    frac = (bins == b5).mean()
+    # the 5.0 spike dominates its bin
+    assert frac > 0.85
+
+
+def test_categorical_basic():
+    v = np.array([3.0, 3.0, 3.0, 1.0, 1.0, 7.0] * 10)
+    m = BinMapper.from_values(v, bin_type="categorical", max_bin=32)
+    b = m.values_to_bins(np.array([3.0, 1.0, 7.0, 99.0]))
+    assert b[0] == 0  # most frequent first
+    assert len({b[0], b[1], b[2]}) == 3
+    assert b[3] == 0  # unseen -> bin 0
+
+
+def test_threshold_value_roundtrip():
+    rng = np.random.RandomState(4)
+    v = rng.uniform(0, 10, 5000)
+    m = BinMapper.from_values(v, max_bin=64)
+    bins = m.values_to_bins(v)
+    for t in [5, 20, 40]:
+        thr = m.bin_to_threshold_value(t)
+        lhs = v <= thr
+        rhs = bins <= t
+        assert (lhs == rhs).all()
