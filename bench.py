@@ -1,0 +1,80 @@
+"""Driver benchmark: Higgs-class binary training throughput on one chip.
+
+Mirrors the reference's headline experiment (docs/Experiments.rst:110-134 —
+Higgs 10.5M rows x 28 features, 500 iters, 255 leaves, 130.094 s on a
+2x E5-2690 v4) using a synthetic Higgs-shaped dataset, and the 63-bin
+configuration of the reference's own GPU speed comparison
+(docs/GPU-Performance.rst:108-123) which it shows is AUC-neutral.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured against the reference CPU throughput
+10.5e6 * 500 / 130.094 s = 40.36M row-trees/s.
+
+Env knobs: BENCH_ROWS (default 1_048_576), BENCH_ITERS (default 40),
+BENCH_MAX_BIN (default 63).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ROW_TREES_PER_S = 10_500_000 * 500 / 130.094  # Experiments.rst:113
+
+
+def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 7):
+    """Synthetic stand-in with Higgs-like shape: dense floats, a nonlinear
+    decision surface, balanced classes."""
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n_rows, n_feat)).astype(np.float32)
+    w = rng.normal(size=n_feat) / np.sqrt(n_feat)
+    logit = (X @ w + 0.7 * X[:, 0] * X[:, 1]
+             - 0.4 * X[:, 2] ** 2 + 0.3 * np.abs(X[:, 3]))
+    y = (logit + rng.logistic(size=n_rows) * 0.5 > 0).astype(np.float32)
+    return X, y
+
+
+def main():
+    import lightgbm_tpu as lgb
+
+    n_rows = int(os.environ.get("BENCH_ROWS", 1 << 20))
+    iters = int(os.environ.get("BENCH_ITERS", 40))
+    max_bin = int(os.environ.get("BENCH_MAX_BIN", 63))
+    warmup = 3
+
+    X, y = make_higgs_like(n_rows)
+    params = dict(objective="binary", metric="auc", num_leaves=255,
+                  learning_rate=0.1, max_bin=max_bin, leaf_batch=21,
+                  min_data_in_leaf=100, verbosity=-1)
+
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(params, ds, num_boost_round=warmup)
+    t_setup = time.time() - t0
+    print(f"setup+bin+compile+{warmup} warmup iters: {t_setup:.1f}s",
+          file=sys.stderr)
+
+    t1 = time.time()
+    for _ in range(iters):
+        bst.update()
+    # force all queued device work to finish
+    bst._gbdt.scores.block_until_ready()
+    dt = time.time() - t1
+
+    throughput = n_rows * iters / dt
+    auc = bst.eval_train()[0][2]
+    print(f"{iters} iters in {dt:.2f}s = {dt / iters * 1e3:.0f} ms/tree, "
+          f"train AUC {auc:.4f}", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "higgs_binary_train_throughput",
+        "value": round(throughput, 1),
+        "unit": "row-trees/s",
+        "vs_baseline": round(throughput / BASELINE_ROW_TREES_PER_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -51,15 +51,27 @@ def _pad_rows(arr: np.ndarray, r_pad: int, fill=0):
 
 
 class _DeviceData:
-    """Device-resident binned matrix + co-partition state for one dataset."""
+    """Device-resident binned matrix + co-partition state for one dataset.
 
-    def __init__(self, ds: Dataset, block: int):
+    With a data-parallel plan, rows are sharded across the mesh's data axis
+    (the per-machine row partition of data_parallel_tree_learner.cpp, done
+    by jax.sharding instead of pre_partition'd files)."""
+
+    def __init__(self, ds: Dataset, block: int, plan=None):
         self.num_data = ds.num_data
-        self.r_pad = ((ds.num_data + block - 1) // block) * block
-        self.bins = jnp.asarray(_pad_rows(ds.bins, self.r_pad))
-        self.row_leaf0 = jnp.asarray(
-            np.where(np.arange(self.r_pad) < ds.num_data, 0, -1)
-            .astype(np.int32))
+        if plan is not None:
+            self.r_pad = plan.pad_to(ds.num_data, block)
+        else:
+            self.r_pad = ((ds.num_data + block - 1) // block) * block
+        bins = _pad_rows(ds.bins, self.r_pad)
+        row_leaf0 = np.where(np.arange(self.r_pad) < ds.num_data, 0, -1) \
+            .astype(np.int32)
+        if plan is not None:
+            self.bins = plan.shard_rows(bins)
+            self.row_leaf0 = plan.shard_rows(row_leaf0)
+        else:
+            self.bins = jnp.asarray(bins)
+            self.row_leaf0 = jnp.asarray(row_leaf0)
 
 
 class GBDT:
@@ -81,17 +93,34 @@ class GBDT:
         F = self.train_set.num_features
         self.B = int(self.train_set.max_num_bin)
         self.block = block_rows_for(self.train_set.num_data, F, self.B)
-        self.train_dd = _DeviceData(self.train_set, self.block)
+        # data-parallel over every local device (tree_learner param,
+        # tree_learner.cpp:15 factory analog; "serial" pins one device)
+        n_dev = len(jax.devices())
+        self.plan = None
+        if n_dev > 1 and config.tree_learner != "serial":
+            from ..parallel.data_parallel import DataParallelPlan
+            self.plan = DataParallelPlan()
+            # keep the scan block well under the per-shard row count so
+            # shard-granular padding stays a small fraction of the data
+            per_shard = -(-self.train_set.num_data // n_dev)
+            cap = max(256, 1 << int(np.floor(np.log2(
+                max(1, per_shard // 4)))))
+            self.block = min(self.block, cap)
+        self.train_dd = _DeviceData(self.train_set, self.block, self.plan)
         self.valid_dd = [
-            _DeviceData(v.construct(), self.block) for v in valid_sets]
+            _DeviceData(v.construct(), self.block, self.plan)
+            for v in valid_sets]
         self.valid_sets = list(valid_sets)
 
         R = self.train_dd.r_pad
         lbl = self.train_set.get_label()
-        self.label_dev = jnp.asarray(
-            _pad_rows(np.asarray(lbl, np.float32), R))
+
+        def _row_put(a):
+            return (self.plan.shard_rows(a) if self.plan is not None
+                    else jnp.asarray(a))
+        self.label_dev = _row_put(_pad_rows(np.asarray(lbl, np.float32), R))
         w = self.train_set.get_weight()
-        self.weight_dev = None if w is None else jnp.asarray(
+        self.weight_dev = None if w is None else _row_put(
             _pad_rows(np.asarray(w, np.float32), R))
 
         if objective is not None:
@@ -246,7 +275,9 @@ class GBDT:
         should_continue = False
         for k in range(self.K):
             gh = jnp.stack([g[k], h[k], count_mask], axis=1)
-            tree_arrays, row_leaf, valid_rls = build_tree(
+            builder = (self.plan.build_tree if self.plan is not None
+                       else build_tree)
+            tree_arrays, row_leaf, valid_rls = builder(
                 self.train_dd.bins, gh, self.train_dd.row_leaf0,
                 self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
                 num_leaves=cfg.num_leaves, leaf_batch=cfg.leaf_batch,

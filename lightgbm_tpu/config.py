@@ -58,9 +58,11 @@ PARAMS: Dict[str, ParamSpec] = {
         _p("num_leaves", 31, int, aliases=("num_leaf", "max_leaves", "max_leaf",
                                            "max_leaf_nodes"),
            check=lambda v: 1 < v <= 131072),
-        _p("tree_learner", "serial", str,
+        _p("tree_learner", "auto", str,
            aliases=("tree", "tree_type", "tree_learner_type"),
-           doc="serial | data | feature | voting"),
+           doc="auto | serial | data | feature | voting — auto scales to "
+               "every local device (data-parallel) when more than one is "
+               "visible; serial pins one device"),
         _p("num_threads", 0, int, aliases=("num_thread", "nthread", "nthreads",
                                            "n_jobs")),
         _p("device_type", "tpu", str, aliases=("device",)),

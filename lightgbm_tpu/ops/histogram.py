@@ -117,7 +117,11 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
     if axis_name is not None:
         # inside shard_map the blocked inputs vary over the mapped axis;
         # the scan carry must carry the same varying-axis type
-        acc0 = jax.lax.pvary(acc0, axis_name)
+        pcast = getattr(jax.lax, "pcast", None)
+        if pcast is not None:
+            acc0 = pcast(acc0, axis_name, to="varying")
+        else:  # older jax
+            acc0 = jax.lax.pvary(acc0, axis_name)
     acc, _ = jax.lax.scan(body, acc0, (bins_b, gh_b, leaf_b))
     hist = acc.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
     if axis_name is not None:

@@ -1,1 +1,11 @@
-"""Distributed training: mesh setup, sharded training step."""
+"""Distributed training over jax.sharding meshes (SURVEY.md §2.3/§2.4).
+
+The reference's socket/MPI Network layer + parallel tree learners collapse
+into XLA collectives here; see data_parallel.py.
+"""
+
+from .data_parallel import (DataParallelPlan, build_tree_dp, make_mesh,
+                            replicate, shard_rows)
+
+__all__ = ["DataParallelPlan", "build_tree_dp", "make_mesh", "replicate",
+           "shard_rows"]

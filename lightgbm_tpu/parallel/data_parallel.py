@@ -1,0 +1,165 @@
+"""Data-parallel tree learning over a device mesh.
+
+TPU-native analog of the reference distributed tree learners
+(``src/treelearner/data_parallel_tree_learner.cpp`` +
+``src/network/network.cpp``; SURVEY.md §2.3/§2.4):
+
+- The reference shards rows across machines, builds local histograms for all
+  features, merges them with ``Network::ReduceScatter`` (per-worker feature
+  blocks), finds the best split for the local block, and syncs the winner with
+  ``Allreduce(max-gain)`` (``SyncUpGlobalBestSplit``,
+  ``parallel_tree_learner.h:209``).
+- Here the row shard lives on each chip of a ``jax.sharding.Mesh`` axis
+  (ICI within a slice, DCN across hosts) and the whole merge collapses into
+  one ``jax.lax.psum`` of the histogram inside ``ops/histogram.py``. After
+  the psum the histogram is replicated, so every chip runs the *same*
+  split selection and produces the *same* tree — a deterministic replicated
+  argmax needs no winner sync at all. The only cross-chip traffic per round
+  is the histogram reduction, exactly the reference's dominant payload.
+- The machines/ports machinery (``linkers_socket.cpp``) is replaced by
+  ``jax.distributed`` + the mesh; topology/algorithm selection
+  (Bruck/recursive-halving, ``linker_topo.cpp``) becomes XLA's problem.
+
+Feature-parallel and voting-parallel (SURVEY.md §2.3) remap here too:
+with rows replicated and features sharded the same program becomes
+feature-parallel (psum degenerates to a no-op on feature-disjoint
+histograms); voting's top-k communication saving is unnecessary on ICI
+bandwidth but can be added as a histogram-subset psum later.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.split import SplitParams
+from ..boosting.tree_builder import build_tree, TreeArrays
+
+__all__ = ["make_mesh", "shard_rows", "replicate", "build_tree_dp",
+           "DataParallelPlan"]
+
+AXIS = "data"
+
+
+def make_mesh(devices: Optional[Sequence[jax.Device]] = None,
+              axis_name: str = AXIS) -> Mesh:
+    """1-D data mesh over all (or the given) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def shard_rows(mesh: Mesh, arr, axis_name: str = AXIS) -> jax.Array:
+    """Place an array on the mesh sharded along its leading (row) axis."""
+    spec = P(axis_name, *([None] * (np.ndim(arr) - 1)))
+    return jax.device_put(arr, NamedSharding(mesh, spec))
+
+
+def replicate(mesh: Mesh, arr) -> jax.Array:
+    return jax.device_put(arr, NamedSharding(mesh, P()))
+
+
+class DataParallelPlan:
+    """Holds the mesh + sharding helpers for one training run.
+
+    The analog of the reference's ``Network::Init`` + per-machine rank state
+    (``network.cpp:17-58``): constructed once, then every tree build routes
+    through :meth:`build_tree` below.
+    """
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None,
+                 axis_name: str = AXIS):
+        self.mesh = make_mesh(devices, axis_name)
+        self.axis_name = axis_name
+        self.num_shards = self.mesh.devices.size
+
+    def pad_to(self, num_rows: int, block: int) -> int:
+        """Rows must divide evenly into shards × row-blocks."""
+        unit = block * self.num_shards
+        return ((num_rows + unit - 1) // unit) * unit
+
+    def shard_rows(self, arr):
+        return shard_rows(self.mesh, arr, self.axis_name)
+
+    def replicate(self, arr):
+        return replicate(self.mesh, arr)
+
+    def build_tree(self, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
+                   is_cat_pf, feature_mask, *, num_leaves: int,
+                   leaf_batch: int, max_depth: int, num_bins: int,
+                   split_params: SplitParams, hist_dtype: str = "bfloat16",
+                   block_rows: int = 0,
+                   valid_bins: Tuple[jax.Array, ...] = (),
+                   valid_row_leaf0: Tuple[jax.Array, ...] = ()):
+        return build_tree_dp(
+            self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
+            is_cat_pf, feature_mask, num_leaves=num_leaves,
+            leaf_batch=leaf_batch, max_depth=max_depth, num_bins=num_bins,
+            split_params=split_params, axis_name=self.axis_name,
+            hist_dtype=hist_dtype, block_rows=block_rows,
+            valid_bins=valid_bins, valid_row_leaf0=valid_row_leaf0)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
+                     "num_bins", "split_params", "axis_name", "hist_dtype",
+                     "block_rows", "n_valid"))
+def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
+                       is_cat_pf, feature_mask, valid_flat, *,
+                       num_leaves, leaf_batch, max_depth, num_bins,
+                       split_params, axis_name, hist_dtype, block_rows,
+                       n_valid):
+    row = P(axis_name)
+    row2 = P(axis_name, None)
+    rep = P()
+
+    def step(b, g, rl, nbpf, nanpf, catpf, fmask, vflat):
+        vbins = tuple(vflat[:n_valid])
+        vrl = tuple(vflat[n_valid:])
+        return build_tree(
+            b, g, rl, nbpf, nanpf, catpf, fmask,
+            num_leaves=num_leaves, leaf_batch=leaf_batch,
+            max_depth=max_depth, num_bins=num_bins,
+            split_params=split_params, axis_name=axis_name,
+            hist_dtype=hist_dtype, block_rows=block_rows,
+            valid_bins=vbins, valid_row_leaf0=vrl)
+
+    tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
+        *([0] * len(TreeArrays._fields))))
+    valid_in_specs = tuple([row2] * n_valid + [row] * n_valid)
+    out_valid_specs = tuple([row] * n_valid)
+
+    fn = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(row2, row2, row, rep, rep, rep, rep, valid_in_specs),
+        out_specs=(tree_specs, row, out_valid_specs))
+    return fn(bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
+              feature_mask, valid_flat)
+
+
+def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
+                  is_cat_pf, feature_mask, *, num_leaves: int,
+                  leaf_batch: int, max_depth: int, num_bins: int,
+                  split_params: SplitParams, axis_name: str = AXIS,
+                  hist_dtype: str = "bfloat16", block_rows: int = 0,
+                  valid_bins: Tuple[jax.Array, ...] = (),
+                  valid_row_leaf0: Tuple[jax.Array, ...] = ()):
+    """Grow one tree with rows sharded over ``axis_name``.
+
+    Same contract as :func:`..boosting.tree_builder.build_tree`; the
+    returned TreeArrays are replicated (identical on every chip), the
+    returned row→leaf assignments stay row-sharded.
+    """
+    valid_flat = tuple(valid_bins) + tuple(valid_row_leaf0)
+    return _build_tree_dp_jit(
+        mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
+        feature_mask, valid_flat, num_leaves=num_leaves,
+        leaf_batch=leaf_batch, max_depth=max_depth, num_bins=num_bins,
+        split_params=split_params, axis_name=axis_name,
+        hist_dtype=hist_dtype, block_rows=block_rows,
+        n_valid=len(valid_bins))

@@ -1,0 +1,91 @@
+"""Data-parallel (shard_map) tree build vs single-device oracle.
+
+Mirrors the reference distributed test strategy
+(tests/distributed/_test_distributed.py asserts data-parallel training
+matches expectations on synthetic data) — here the 8 virtual CPU devices
+from conftest stand in for TPU chips.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from lightgbm_tpu.boosting.tree_builder import build_tree
+from lightgbm_tpu.ops.split import SplitParams
+from lightgbm_tpu.parallel.data_parallel import (DataParallelPlan,
+                                                 build_tree_dp, make_mesh)
+
+
+def _data(rng, R=1024, F=6, B=32):
+    bins = rng.randint(0, B, size=(R, F)).astype(np.uint8)
+    g = rng.normal(size=R).astype(np.float32)
+    h = rng.uniform(0.5, 1.5, size=R).astype(np.float32)
+    gh = np.stack([g, h, np.ones(R, np.float32)], axis=1)
+    meta = dict(
+        num_bins_pf=jnp.full((F,), B, jnp.int32),
+        nan_bin_pf=jnp.full((F,), -1, jnp.int32),
+        is_cat_pf=jnp.zeros((F,), bool),
+        feature_mask=jnp.ones((F,), bool),
+    )
+    return bins, gh, meta
+
+
+SP = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+KW = dict(num_leaves=15, leaf_batch=4, max_depth=-1, num_bins=32,
+          split_params=SP, hist_dtype="float32")
+
+
+def test_dp_tree_matches_single_device(rng):
+    bins, gh, meta = _data(rng)
+    R = bins.shape[0]
+    rl0 = np.zeros(R, np.int32)
+
+    ref_tree, ref_rl, _ = build_tree(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R, **KW)
+
+    plan = DataParallelPlan()
+    nsh = plan.num_shards
+    assert nsh == 8
+    got_tree, got_rl, _ = plan.build_tree(
+        plan.shard_rows(bins), plan.shard_rows(gh), plan.shard_rows(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R // nsh, **KW)
+
+    assert int(got_tree.num_leaves) == int(ref_tree.num_leaves)
+    np.testing.assert_array_equal(np.asarray(got_tree.split_feature),
+                                  np.asarray(ref_tree.split_feature))
+    np.testing.assert_array_equal(np.asarray(got_tree.threshold_bin),
+                                  np.asarray(ref_tree.threshold_bin))
+    np.testing.assert_allclose(np.asarray(got_tree.leaf_values),
+                               np.asarray(ref_tree.leaf_values),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_rl), np.asarray(ref_rl))
+
+
+def test_dp_valid_copartition(rng):
+    bins, gh, meta = _data(rng)
+    vbins, _, _ = _data(rng, R=512)
+    R, VR = bins.shape[0], vbins.shape[0]
+    rl0 = np.zeros(R, np.int32)
+    vrl0 = np.zeros(VR, np.int32)
+
+    _, _, ref_v = build_tree(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R,
+        valid_bins=(jnp.asarray(vbins),),
+        valid_row_leaf0=(jnp.asarray(vrl0),), **KW)
+
+    plan = DataParallelPlan()
+    nsh = plan.num_shards
+    _, _, got_v = plan.build_tree(
+        plan.shard_rows(bins), plan.shard_rows(gh), plan.shard_rows(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R // nsh,
+        valid_bins=(plan.shard_rows(vbins),),
+        valid_row_leaf0=(plan.shard_rows(vrl0),), **KW)
+
+    np.testing.assert_array_equal(np.asarray(got_v[0]), np.asarray(ref_v[0]))
