@@ -1,1 +1,23 @@
-"""Boosting strategies: GBDT training loop, DART, RF, sampling."""
+"""Boosting strategies: GBDT training loop, DART, RF, sampling.
+
+Factory analog of ``Boosting::CreateBoosting`` (src/boosting/boosting.cpp:34);
+``boosting=goss`` is resolved to gbdt + goss sampling by the Config layer.
+"""
+
+from .gbdt import GBDT
+
+
+def create_boosting(config, train_set, objective, valid_sets=()):
+    name = config.boosting
+    if name == "gbdt":
+        return GBDT(config, train_set, objective, valid_sets)
+    if name == "dart":
+        from .dart import DART
+        return DART(config, train_set, objective, valid_sets)
+    if name == "rf":
+        from .rf import RF
+        return RF(config, train_set, objective, valid_sets)
+    raise ValueError(f"Unknown boosting type {name}")
+
+
+__all__ = ["GBDT", "create_boosting"]

@@ -1,0 +1,149 @@
+"""DART boosting (Dropouts meet Multiple Additive Regression Trees).
+
+Analog of the reference ``src/boosting/dart.hpp`` (``DART`` :23):
+per iteration a random subset of existing trees is "dropped" (their
+contribution removed from the training scores before gradients are
+computed), the new tree is trained at shrinkage lr/(1+k) (or the xgboost
+variant lr/(lr+k)), and the dropped trees are rescaled by k/(k+1)
+(resp. k/(lr+k)) so the ensemble stays normalized.
+
+TPU mapping: the reference's ScoreUpdater::AddScore replays each dropped
+tree over all rows on the CPU; here the replay is one jitted traversal of
+the stored device tree over the binned matrix (ops/predict.py), and the
+per-row prediction is cached for the restore step so each dropped tree is
+traversed once per iteration.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..dataset import Dataset
+from ..objectives import Objective
+from .gbdt import GBDT
+
+__all__ = ["DART"]
+
+
+class DART(GBDT):
+    keep_device_trees = True  # drop/restore replays stored trees
+
+    def __init__(self, config: Config, train_set: Dataset,
+                 objective: Optional[Objective],
+                 valid_sets: Sequence[Dataset] = ()):
+        super().__init__(config, train_set, objective, valid_sets)
+        self._rng_drop = np.random.RandomState(config.drop_seed)
+        self._tree_weight: List[float] = []  # per-iteration weights
+        self._sum_weight = 0.0
+        self._dropped: Optional[tuple] = None  # (drop, preds) this iter
+
+    # -- dart.hpp DroppingTrees ---------------------------------------
+    def _select_drop(self) -> List[int]:
+        cfg = self.config
+        n = self.iter_
+        drop: List[int] = []
+        if self._rng_drop.rand() >= cfg.skip_drop and n > 0:
+            drop_rate = cfg.drop_rate
+            max_drop = cfg.max_drop if cfg.max_drop > 0 else np.inf
+            if not cfg.uniform_drop:
+                inv_avg = n / self._sum_weight
+                if cfg.max_drop > 0:
+                    drop_rate = min(
+                        drop_rate,
+                        cfg.max_drop * inv_avg / self._sum_weight)
+                for i in range(n):
+                    if self._rng_drop.rand() < \
+                            drop_rate * self._tree_weight[i] * inv_avg:
+                        drop.append(i)
+                        if len(drop) >= max_drop:
+                            break
+            else:
+                if cfg.max_drop > 0:
+                    drop_rate = min(drop_rate, cfg.max_drop / n)
+                for i in range(n):
+                    if self._rng_drop.rand() < drop_rate:
+                        drop.append(i)
+                        if len(drop) >= max_drop:
+                            break
+        k = len(drop)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage = cfg.learning_rate / (1.0 + k)
+        else:
+            self.shrinkage = (cfg.learning_rate if k == 0 else
+                              cfg.learning_rate / (cfg.learning_rate + k))
+        return drop
+
+    def _tree_preds(self, it: int):
+        """Per-row unshrunk outputs of iteration `it`'s K trees on train
+        and every valid set (each traversed once, cached per call)."""
+        train = [self.predict_device_tree(it * self.K + k, -1)
+                 for k in range(self.K)]
+        valids = [[self.predict_device_tree(it * self.K + k, vi)
+                   for k in range(self.K)]
+                  for vi in range(len(self.valid_dd))]
+        return train, valids
+
+    def _ensure_dropped(self):
+        """Drop once per iteration — triggered either by gradient
+        computation (custom fobj path, mirroring dart.hpp
+        GetTrainingScore/is_update_score_cur_iter_) or by train_one_iter."""
+        if self._dropped is not None:
+            return
+        drop = self._select_drop()
+        preds = {}
+        for it in drop:
+            preds[it] = self._tree_preds(it)
+            w = self._tree_weight[it]
+            tr, _ = preds[it]
+            for ki in range(self.K):
+                self.scores = self.scores.at[ki].add(-w * tr[ki])
+        self._dropped = (drop, preds)
+
+    def get_training_scores(self) -> np.ndarray:
+        self._ensure_dropped()
+        return super().get_training_scores()
+
+    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+        cfg = self.config
+        self._ensure_dropped()
+        drop, preds = self._dropped
+        self._dropped = None
+        k = float(len(drop))
+
+        stop = super().train_one_iter(gradients, hessians)
+        if stop:
+            # restore dropped contributions; iteration was a no-op
+            for it in drop:
+                w = self._tree_weight[it]
+                tr, _ = preds[it]
+                for ki in range(self.K):
+                    self.scores = self.scores.at[ki].add(w * tr[ki])
+            return True
+
+        # normalize (dart.hpp Normalize)
+        if k > 0:
+            factor = (k / (k + 1.0) if not cfg.xgboost_dart_mode
+                      else k / (k + cfg.learning_rate))
+            for it in drop:
+                w = self._tree_weight[it]
+                new_w = w * factor
+                tr, vas = preds[it]
+                for ki in range(self.K):
+                    mi = it * self.K + ki
+                    # train: was fully dropped -> add back at new weight
+                    self.scores = self.scores.at[ki].add(new_w * tr[ki])
+                    for vi in range(len(self.valid_dd)):
+                        self.valid_scores[vi] = self.valid_scores[vi] \
+                            .at[ki].add(-(w - new_w) * vas[vi][ki])
+                    # rescale the saved model + weight bookkeeping
+                    self.models[mi].scale(factor)
+                self._sum_weight -= w * (1.0 - factor)
+                self._tree_weight[it] = new_w
+
+        self._tree_weight.append(self.shrinkage)
+        self._sum_weight += self.shrinkage
+        return False

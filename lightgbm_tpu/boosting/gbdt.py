@@ -75,6 +75,10 @@ class _DeviceData:
 
 
 class GBDT:
+    # subclasses that replay past trees (DART) keep them on device;
+    # plain gbdt/rf retain only the host Tree models
+    keep_device_trees = False
+
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[Objective],
                  valid_sets: Sequence[Dataset] = ()):
@@ -83,6 +87,9 @@ class GBDT:
         self.objective = objective
         self.iter_ = 0
         self.models: List[Tree] = []
+        # (TreeArrays, weight) per trained tree, kept on device for DART
+        # drop/restore, rollback and refit (HistogramPool-sized: ~KBs/tree)
+        self.device_trees: List[Tuple[TreeArrays, float]] = []
         self.num_class = config.num_class
         self.K = (objective.num_model_per_iteration
                   if objective is not None else max(1, config.num_class))
@@ -250,45 +257,64 @@ class GBDT:
         return jnp.asarray(m)
 
     # ------------------------------------------------------------------
+    def _prep_custom_gh(self, gradients, hessians):
+        """Custom fobj arrays: flat [K*num_data] class-major
+        (LGBM_BoosterUpdateOneIterCustom layout) or [num_data, K]."""
+        R = self.train_dd.r_pad
+
+        def prep(a):
+            a = np.asarray(a, np.float32)
+            n = self.train_dd.num_data
+            if a.ndim == 1:
+                a = a.reshape(self.K, n)
+            else:
+                a = a.T
+            return jnp.asarray(_pad_rows(a.T, R)).T
+        return prep(gradients), prep(hessians)
+
+    def _build_one_tree(self, gh: jax.Array, fmask: jax.Array):
+        """One tree on the current gradients; returns device results."""
+        cfg = self.config
+        builder = (self.plan.build_tree if self.plan is not None
+                   else build_tree)
+        return builder(
+            self.train_dd.bins, gh, self.train_dd.row_leaf0,
+            self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
+            num_leaves=cfg.num_leaves, leaf_batch=cfg.leaf_batch,
+            max_depth=cfg.max_depth, num_bins=self.B,
+            split_params=self.split_params,
+            hist_dtype=cfg.hist_dtype, block_rows=self.block,
+            valid_bins=tuple(dd.bins for dd in self.valid_dd),
+            valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd))
+
+    def _bias_adjust_device(self, tree_arrays: TreeArrays, bias: float,
+                            shrink: float) -> TreeArrays:
+        """Fold an output bias into the stored device tree so that
+        weight * node_value includes it (AddBias, tree.h; keeps DART /
+        rollback / init_model score arithmetic consistent with the
+        host-side first-tree bias of gbdt.cpp:416)."""
+        adj = jnp.float32(bias / shrink)
+        return tree_arrays._replace(
+            node_value=tree_arrays.node_value + adj,
+            leaf_values=tree_arrays.leaf_values + adj)
+
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
                        hessians: Optional[np.ndarray] = None) -> bool:
         """Returns True when training should stop (no splits possible)."""
-        cfg = self.config
-        R = self.train_dd.r_pad
         if gradients is None or hessians is None:
             g, h = self._grads(self.iter_)
         else:
-            # custom fobj arrays: flat [K*num_data] in class-major order
-            # (LGBM_BoosterUpdateOneIterCustom layout) or [num_data, K]
-            def prep(a):
-                a = np.asarray(a, np.float32)
-                n = self.train_dd.num_data
-                if a.ndim == 1:
-                    a = a.reshape(self.K, n)
-                else:
-                    a = a.T
-                return jnp.asarray(_pad_rows(a.T, R)).T
-            g, h = prep(gradients), prep(hessians)
+            g, h = self._prep_custom_gh(gradients, hessians)
         g, h, count_mask = self._sampling(self.iter_, g, h)
 
         fmask = self._feature_mask()
         should_continue = False
         for k in range(self.K):
             gh = jnp.stack([g[k], h[k], count_mask], axis=1)
-            builder = (self.plan.build_tree if self.plan is not None
-                       else build_tree)
-            tree_arrays, row_leaf, valid_rls = builder(
-                self.train_dd.bins, gh, self.train_dd.row_leaf0,
-                self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
-                num_leaves=cfg.num_leaves, leaf_batch=cfg.leaf_batch,
-                max_depth=cfg.max_depth, num_bins=self.B,
-                split_params=self.split_params,
-                hist_dtype=cfg.hist_dtype, block_rows=self.block,
-                valid_bins=tuple(dd.bins for dd in self.valid_dd),
-                valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd))
+            tree_arrays, row_leaf, valid_rls = self._build_one_tree(gh, fmask)
             host = jax.tree.map(np.asarray, tree_arrays)
             num_leaves_trained = int(host.num_leaves)
-            shrink = 1.0 if self.config.boosting == "rf" else self.shrinkage
+            shrink = self.shrinkage
             if num_leaves_trained > 1:
                 should_continue = True
                 lr = jnp.asarray(shrink, jnp.float32)
@@ -301,19 +327,38 @@ class GBDT:
                             tree_arrays.leaf_values, vrl, lr))
             tree = Tree.from_device(host, self.train_set.bin_mappers,
                                     self.train_set.used_features, shrink)
-            if self.iter_ == 0 and abs(self._init_scores[k]) > kEpsilon:
+            bias = self._init_scores[k]
+            if self.iter_ == 0 and abs(bias) > kEpsilon:
                 # AddBias (gbdt.cpp:416): fold init score into first tree
-                tree.leaf_value += self._init_scores[k]
-                tree.internal_value += self._init_scores[k]
+                tree.leaf_value += bias
+                tree.internal_value += bias
+                # scores already start at the init score; only the STORED
+                # device tree carries the bias so later per-tree score
+                # arithmetic (DART drop, rollback, refit) stays consistent
+                tree_arrays = self._bias_adjust_device(tree_arrays, bias,
+                                                       shrink)
             self.models.append(tree)
+            if self.keep_device_trees:
+                self.device_trees.append((tree_arrays, shrink))
 
         if not should_continue and self.iter_ > 0:
             # drop the no-op iteration, reference gbdt.cpp:441-447
             for _ in range(self.K):
                 self.models.pop()
+                if self.keep_device_trees:
+                    self.device_trees.pop()
             return True
         self.iter_ += 1
         return False
+
+    # ------------------------------------------------------------------
+    def predict_device_tree(self, idx: int, which: int = -1) -> jax.Array:
+        """[R] unshrunk per-row output of stored tree `idx` on the train
+        (which=-1) or valid dataset's binned rows."""
+        tree_arrays, _ = self.device_trees[idx]
+        dd = self.train_dd if which < 0 else self.valid_dd[which]
+        from ..ops.predict import predict_bins_value
+        return predict_bins_value(tree_arrays, self.nan_bin_pf, dd.bins)
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self):
@@ -322,6 +367,12 @@ class GBDT:
         raise NotImplementedError(
             "rollback_one_iter requires per-tree partition retention; "
             "planned alongside refit")
+
+    # ------------------------------------------------------------------
+    def get_training_scores(self) -> np.ndarray:
+        """Scores handed to custom objectives (GetTrainingScore analog,
+        boosting.h; DART overrides to apply its dropout first)."""
+        return self.eval_scores(-1)
 
     # ------------------------------------------------------------------
     def eval_scores(self, which: int = -1) -> np.ndarray:

@@ -48,6 +48,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.histogram import build_histograms, HIST_CH
+from ..ops.predict import row_feature_gather
 from ..ops.split import SplitParams, find_best_splits, leaf_output
 
 __all__ = ["TreeArrays", "build_tree", "max_rounds_for"]
@@ -80,14 +81,6 @@ def max_rounds_for(num_leaves: int, leaf_batch: int) -> int:
         cur += min(leaf_batch, cur, num_leaves - cur)
         r += 1
     return r
-
-
-def _row_feature_gather(bins: jax.Array, feat: jax.Array) -> jax.Array:
-    """bins[r, feat[r]] without a dynamic gather: one-hot multiply-reduce
-    keeps the VPU busy instead of serializing on gathers."""
-    F = bins.shape[1]
-    sel = jnp.arange(F, dtype=jnp.int32)[None, :] == feat[:, None]
-    return jnp.sum(jnp.where(sel, bins.astype(jnp.int32), 0), axis=1)
 
 
 @functools.partial(
@@ -268,7 +261,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             rlc = jnp.where(rl < 0, DUMMY_LEAF, rl)
             active = jnp.take(pend_active, rlc)
             feat = jnp.take(pend_feat, rlc)
-            binv = _row_feature_gather(bmat, feat)
+            binv = row_feature_gather(bmat, feat)
             thr = jnp.take(pend_thr, rlc)
             nb = jnp.take(nan_bin_pf, feat)
             isnan = (binv == nb) & (nb >= 0)

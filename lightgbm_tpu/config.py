@@ -368,10 +368,14 @@ class Config:
         """Analog of Config::CheckParamConflict (config.h:1167)."""
         v = self._values
         if v.get("boosting") == "rf":
-            if self.bagging_freq <= 0 or not (0 < self.bagging_fraction < 1):
+            # rf.hpp Init: bagging OR feature sampling qualifies
+            has_bag = (self.bagging_freq > 0
+                       and 0 < self.bagging_fraction < 1)
+            has_ff = 0 < self.feature_fraction < 1
+            if not (has_bag or has_ff):
                 raise ValueError(
-                    "rf boosting requires bagging_freq > 0 and "
-                    "0 < bagging_fraction < 1")
+                    "rf boosting requires bagging (bagging_freq > 0 and "
+                    "0 < bagging_fraction < 1) or feature_fraction < 1")
         if self.data_sample_strategy == "goss" and v.get("boosting") == "rf":
             raise ValueError("goss sampling cannot be used with rf boosting")
         if self.objective in ("multiclass", "multiclassova") \

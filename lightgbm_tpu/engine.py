@@ -15,6 +15,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, \
 
 import numpy as np
 
+from .boosting import create_boosting
 from .boosting.gbdt import GBDT
 from .callback import CallbackEnv, EarlyStopException
 from .config import Config
@@ -46,6 +47,7 @@ class Booster:
         self._max_feature_idx = 0
         self._metrics: List[Metric] = []
         self._train_metrics_data = None
+        self._average_output = False  # RF mode (rf.hpp average_output_)
 
         if model_file is not None:
             with open(model_file) as f:
@@ -76,8 +78,10 @@ class Booster:
     # -- training ------------------------------------------------------
     def _ensure_gbdt(self):
         if self._gbdt is None:
-            self._gbdt = GBDT(self.config, self.train_set, self._objective,
-                              self._valid_sets)
+            self._gbdt = create_boosting(self.config, self.train_set,
+                                         self._objective, self._valid_sets)
+            self._average_output = getattr(self._gbdt, "average_output",
+                                           False)
             self._trees = self._gbdt.models
             for m in self._metrics:
                 m.init(self.train_set.get_label(),
@@ -115,7 +119,10 @@ class Booster:
         return self._gbdt.train_one_iter()
 
     def _current_pred_for_fobj(self):
-        return self._gbdt.eval_scores(-1).squeeze()
+        # get_training_scores (not eval_scores): DART applies its dropout
+        # here so custom gradients see the dropped ensemble (dart.hpp
+        # GetTrainingScore)
+        return self._gbdt.get_training_scores().squeeze()
 
     def reset_parameter(self, params: Dict):
         self.params.update(params)
@@ -195,6 +202,8 @@ class Booster:
         raw = np.zeros((X.shape[0], K))
         for i, t in enumerate(use):
             raw[:, (lo + i) % K] += t.predict(X)
+        if self._average_output and use:
+            raw /= len(use) // K
         if K == 1:
             raw = raw[:, 0]
         if raw_score:
@@ -228,6 +237,10 @@ class Booster:
             "label_index=0",
             f"max_feature_idx={self._max_feature_idx}",
             f"objective={self._objective_text()}",
+        ]
+        if self._average_output:
+            header.append("average_output")  # gbdt_model_text.cpp RF marker
+        header += [
             "feature_names=" + " ".join(self._feature_names),
             "feature_infos=" + " ".join(self._feature_infos_list()),
             "",
@@ -288,7 +301,10 @@ class Booster:
             if "=" in ln:
                 k, v = ln.split("=", 1)
                 header[k] = v
+            elif ln.strip() == "average_output":
+                header["average_output"] = "1"
             i += 1
+        self._average_output = "average_output" in header
         self._num_class = int(header.get("num_class", "1"))
         self._max_feature_idx = int(header.get("max_feature_idx", "0"))
         obj = header.get("objective", "regression").split()

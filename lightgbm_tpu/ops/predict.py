@@ -1,0 +1,85 @@
+"""On-device tree traversal over binned rows.
+
+Analog of the reference prediction path (``src/boosting/gbdt_prediction.cpp``
+``PredictRaw`` :13, ``include/LightGBM/tree.h:135`` ``Tree::Predict``) for
+trees still in device (TreeArrays) form — used by DART's drop/restore score
+arithmetic, continued training (init_model), refit and rollback, where the
+framework needs past trees' per-row outputs without leaving the device.
+
+TPU design: the reference walks pointers per row; here all rows walk the
+node SoA in lock-step — each level is one vectorized gather + compare over
+[R] rows, a ``lax.while_loop`` until every row parks at a leaf. The
+feature-value lookup uses the same one-hot multiply-reduce trick as the
+tree builder (no serializing dynamic gather on the lane axis).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["predict_bins_leaf", "predict_bins_value", "row_feature_gather"]
+
+
+def row_feature_gather(bins: jax.Array, feat: jax.Array) -> jax.Array:
+    """bins[r, feat[r]] without a dynamic gather: one-hot multiply-reduce
+    keeps the VPU busy instead of serializing on gathers. Shared by the
+    tree builder's partition step and prediction traversal — the decision
+    semantics must stay bit-identical between them."""
+    F = bins.shape[1]
+    sel = jnp.arange(F, dtype=jnp.int32)[None, :] == feat[:, None]
+    return jnp.sum(jnp.where(sel, bins.astype(jnp.int32), 0), axis=1)
+
+
+@jax.jit
+def predict_bins_leaf(split_feature: jax.Array, threshold_bin: jax.Array,
+                      default_left: jax.Array, is_cat: jax.Array,
+                      left_child: jax.Array, right_child: jax.Array,
+                      nan_bin_pf: jax.Array, bins: jax.Array) -> jax.Array:
+    """Node index where each binned row lands (NumericalDecision /
+    CategoricalDecision walk of tree.h, vectorized over rows).
+
+    Tree arrays are in builder (TreeArrays) numbering: ``split_feature``
+    holds -1 at leaves; children are node ids in the same arrays.
+    Returns [R] int32 node ids of leaves.
+    """
+    R = bins.shape[0]
+    node = jnp.zeros((R,), jnp.int32)
+
+    def cond(state):
+        node, active = state
+        return jnp.any(active)
+
+    def body(state):
+        node, _ = state
+        feat = jnp.take(split_feature, node)
+        internal = feat >= 0
+        featc = jnp.maximum(feat, 0)
+        binv = row_feature_gather(bins, featc)
+        thr = jnp.take(threshold_bin, node)
+        nb = jnp.take(nan_bin_pf, featc)
+        isnan = (binv == nb) & (nb >= 0)
+        cat = jnp.take(is_cat, node)
+        go_left = jnp.where(cat, binv == thr, binv <= thr)
+        go_left = jnp.where(isnan, jnp.take(default_left, node), go_left)
+        nxt = jnp.where(go_left, jnp.take(left_child, node),
+                        jnp.take(right_child, node))
+        node = jnp.where(internal, nxt, node)
+        still = jnp.take(split_feature, node) >= 0
+        return node, still
+
+    node, _ = jax.lax.while_loop(
+        cond, body, (node, jnp.take(split_feature, node) >= 0))
+    return node
+
+
+def predict_bins_value(tree, nan_bin_pf: jax.Array,
+                       bins: jax.Array) -> jax.Array:
+    """Per-row unshrunk leaf output of one device tree ([R] f32)."""
+    leaf_node = predict_bins_leaf(
+        tree.split_feature, tree.threshold_bin, tree.default_left,
+        tree.is_cat, tree.left_child, tree.right_child, nan_bin_pf, bins)
+    return jnp.take(tree.node_value, leaf_node)

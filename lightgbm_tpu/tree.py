@@ -273,6 +273,14 @@ class Tree:
         tree.shrinkage = float(kv.get("shrinkage", "1"))
         return tree
 
+    def scale(self, factor: float):
+        """Shrinkage(rate) (tree.h): rescale every output in place —
+        DART normalization and rollback arithmetic."""
+        self.leaf_value *= factor
+        self.internal_value *= factor
+        self.shrinkage *= factor
+        return self
+
     def num_nodes(self) -> int:
         return 2 * self.num_leaves - 1
 

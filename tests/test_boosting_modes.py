@@ -1,0 +1,147 @@
+"""DART and RF boosting modes (dart.hpp / rf.hpp semantics).
+
+Key invariant: the internal on-device training scores must equal the saved
+model's predictions — this exercises DART's drop/normalize arithmetic and
+RF's running-average scores end to end.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n=3000, f=8):
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 1.2 - 0.8 * X[:, 1] ** 2 + np.sin(X[:, 2])
+    y = (logit + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+def test_dart_scores_match_model(rng):
+    X, y = _data(rng)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "num_leaves": 15, "learning_rate": 0.2,
+                     "drop_rate": 0.3, "drop_seed": 7, "verbosity": -1},
+                    ds, num_boost_round=25)
+    raw_model = bst.predict(X, raw_score=True)
+    raw_internal = bst._gbdt.eval_scores(-1)[:, 0]
+    np.testing.assert_allclose(raw_model, raw_internal, rtol=2e-4,
+                               atol=2e-4)
+    # dropout should still learn
+    p = bst.predict(X)
+    assert ((p > 0.5) == y).mean() > 0.85
+
+
+def test_dart_improves_and_differs_from_gbdt(rng):
+    X, y = _data(rng)
+    ds = lgb.Dataset(X[:2400], label=y[:2400], free_raw_data=False)
+    common = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": "binary_logloss"}
+    hist_d, hist_g = {}, {}
+    lgb.train({**common, "boosting": "dart", "drop_rate": 0.5,
+               "skip_drop": 0.0}, ds, 20,
+              valid_sets=[lgb.Dataset(X[2400:], label=y[2400:],
+                                      reference=ds)],
+              valid_names=["t"], callbacks=[lgb.record_evaluation(hist_d)])
+    lgb.train(common, ds, 20,
+              valid_sets=[lgb.Dataset(X[2400:], label=y[2400:],
+                                      reference=ds)],
+              valid_names=["t"], callbacks=[lgb.record_evaluation(hist_g)])
+    dart_ll = hist_d["t"]["binary_logloss"]
+    assert dart_ll[-1] < dart_ll[0]
+    assert not np.allclose(dart_ll, hist_g["t"]["binary_logloss"])
+
+
+def test_dart_valid_copartition_consistency(rng):
+    X, y = _data(rng)
+    ds = lgb.Dataset(X[:2400], label=y[:2400], free_raw_data=False)
+    vs = lgb.Dataset(X[2400:], label=y[2400:], reference=ds)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "num_leaves": 15, "drop_rate": 0.3, "verbosity": -1},
+                    ds, 15, valid_sets=[vs])
+    raw_model = bst.predict(X[2400:], raw_score=True)
+    raw_internal = bst._gbdt.eval_scores(0)[:, 0]
+    np.testing.assert_allclose(raw_model, raw_internal, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_rf_scores_match_model(rng):
+    X, y = _data(rng)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_freq": 1, "bagging_fraction": 0.7,
+                     "num_leaves": 31, "verbosity": -1},
+                    ds, num_boost_round=20)
+    raw_model = bst.predict(X, raw_score=True)
+    raw_internal = bst._gbdt.eval_scores(-1)[:, 0]
+    np.testing.assert_allclose(raw_model, raw_internal, rtol=2e-4,
+                               atol=2e-4)
+    p = bst.predict(X)
+    assert ((p > 0.5) == y).mean() > 0.85
+    # model text roundtrip preserves average_output
+    s = bst.model_to_string()
+    assert "average_output" in s
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst2.predict(X, raw_score=True), raw_model,
+                               rtol=1e-6)
+
+
+def test_rf_requires_bagging(rng):
+    X, y = _data(rng, n=200)
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(ValueError):
+        lgb.train({"objective": "binary", "boosting": "rf",
+                   "verbosity": -1}, ds, 2)
+
+
+def test_rf_feature_fraction_only(rng):
+    # rf.hpp Init also accepts feature_fraction < 1 with no bagging
+    X, y = _data(rng, n=1000)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "feature_fraction": 0.6, "num_leaves": 15,
+                     "verbosity": -1}, ds, 8)
+    assert ((bst.predict(X) > 0.5) == y).mean() > 0.8
+
+
+def test_dart_custom_objective_sees_dropped_scores(rng):
+    # custom-gradient path: fobj must receive the dropped ensemble scores
+    # (dart.hpp GetTrainingScore), so model/score consistency must hold
+    X, y = _data(rng)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+
+    def fobj(preds, dataset):
+        lab = dataset.get_label()
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - lab, p * (1.0 - p)
+
+    bst = lgb.train({"objective": "custom", "boosting": "dart",
+                     "num_leaves": 15, "drop_rate": 0.4, "skip_drop": 0.0,
+                     "verbosity": -1}, ds, 15, fobj=fobj)
+    raw_model = bst.predict(X, raw_score=True)
+    raw_internal = bst._gbdt.eval_scores(-1)[:, 0]
+    np.testing.assert_allclose(raw_model, raw_internal, rtol=2e-4,
+                               atol=2e-4)
+
+
+def test_rf_multiclass(rng):
+    X = rng.normal(size=(1500, 6))
+    y = np.argmax(X[:, :3] + 0.3 * rng.normal(size=(1500, 3)), axis=1)
+    ds = lgb.Dataset(X, label=y.astype(float), free_raw_data=False)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "boosting": "rf", "bagging_freq": 1,
+                     "bagging_fraction": 0.6, "num_leaves": 15,
+                     "verbosity": -1}, ds, 10)
+    p = bst.predict(X)
+    assert p.shape == (1500, 3)
+    assert (np.argmax(p, axis=1) == y).mean() > 0.8
+
+
+def test_goss_boosting_alias(rng):
+    X, y = _data(rng)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "boosting": "goss",
+                     "num_leaves": 15, "verbosity": -1}, ds, 15)
+    assert ((bst.predict(X) > 0.5) == y).mean() > 0.85

@@ -1,0 +1,119 @@
+"""sklearn estimator API (reference tests/python_package_test/test_sklearn.py
+strategy: fit/predict on synthetic data, check scores, attributes, and
+sklearn-protocol integration)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LGBMClassifier, LGBMRegressor, LGBMRanker
+
+
+def _cls_data(rng, n=2000, f=10, classes=2):
+    X = rng.normal(size=(n, f))
+    w = rng.normal(size=(f, classes))
+    logits = X @ w + 0.5 * rng.normal(size=(n, classes))
+    y = np.argmax(logits, axis=1)
+    return X, y
+
+
+def test_classifier_binary(rng):
+    X, y = _cls_data(rng)
+    clf = LGBMClassifier(n_estimators=30, num_leaves=15, random_state=42)
+    clf.fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    acc = (clf.predict(X) == y).mean()
+    assert acc > 0.9
+    assert clf.n_classes_ == 2
+    assert list(clf.classes_) == [0, 1]
+    assert clf.n_features_ == 10
+    assert clf.feature_importances_.shape == (10,)
+
+
+def test_classifier_multiclass_string_labels(rng):
+    X, y = _cls_data(rng, classes=3)
+    labels = np.array(["ant", "bee", "cat"])[y]
+    clf = LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X, labels)
+    assert clf.n_classes_ == 3
+    assert set(clf.predict(X)) <= {"ant", "bee", "cat"}
+    assert (clf.predict(X) == labels).mean() > 0.8
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(y), 3)
+
+
+def test_regressor_with_eval_set(rng):
+    X = rng.normal(size=(2000, 8))
+    y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=2000)
+    reg = LGBMRegressor(n_estimators=40, num_leaves=15,
+                        learning_rate=0.15)
+    reg.fit(X[:1500], y[:1500], eval_set=[(X[1500:], y[1500:])],
+            eval_metric="l2")
+    assert "valid_0" in reg.evals_result_
+    hist = reg.evals_result_["valid_0"]["l2"]
+    assert hist[-1] < hist[0]
+    pred = reg.predict(X[1500:])
+    mse = np.mean((pred - y[1500:]) ** 2)
+    assert mse < np.var(y) * 0.2
+
+
+def test_early_stopping_via_callback(rng):
+    X = rng.normal(size=(1200, 5))
+    y = (X[:, 0] > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=200, num_leaves=7)
+    clf.fit(X[:1000], y[:1000], eval_set=[(X[1000:], y[1000:])],
+            callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert clf.best_iteration_ > 0
+    assert clf.best_iteration_ < 200
+
+
+def test_sklearn_protocol(rng):
+    from sklearn.model_selection import cross_val_score
+    X, y = _cls_data(rng, n=600, f=6)
+    clf = LGBMClassifier(n_estimators=10, num_leaves=7)
+    scores = cross_val_score(clf, X, y, cv=3)
+    assert scores.mean() > 0.7
+    # get/set params roundtrip (sklearn clone contract)
+    p = clf.get_params()
+    assert p["n_estimators"] == 10
+    clf.set_params(num_leaves=15)
+    assert clf.get_params()["num_leaves"] == 15
+
+
+def test_not_fitted_error():
+    from sklearn.exceptions import NotFittedError
+    with pytest.raises(NotFittedError):
+        LGBMClassifier().predict(np.zeros((2, 3)))
+
+
+def test_ranker(rng):
+    n_q, q_size, f = 60, 20, 8
+    n = n_q * q_size
+    X = rng.normal(size=(n, f))
+    rel = (X[:, 0] + 0.3 * rng.normal(size=n))
+    y = np.clip(np.digitize(rel, [-0.5, 0.3, 1.0]), 0, 3)
+    group = np.full(n_q, q_size)
+    rk = LGBMRanker(n_estimators=20, num_leaves=7)
+    rk.fit(X, y, group=group)
+    pred = rk.predict(X)
+    # predicted order should correlate with relevance
+    assert np.corrcoef(pred, y)[0, 1] > 0.5
+    with pytest.raises(ValueError, match="group"):
+        LGBMRanker().fit(X, y)
+
+
+def test_class_weight_balanced(rng):
+    X = rng.normal(size=(2000, 6))
+    y = (X[:, 0] + rng.normal(scale=0.5, size=2000) > 1.0).astype(int)
+    assert y.mean() < 0.3  # imbalanced
+    clf = LGBMClassifier(n_estimators=20, num_leaves=7,
+                         class_weight="balanced")
+    clf.fit(X, y)
+    # balanced weighting should raise minority-class recall vs unweighted
+    clf0 = LGBMClassifier(n_estimators=20, num_leaves=7)
+    clf0.fit(X, y)
+    rec_w = clf.predict(X)[y == 1].mean()
+    rec_0 = clf0.predict(X)[y == 1].mean()
+    assert rec_w >= rec_0
