@@ -34,8 +34,8 @@ class DART(GBDT):
 
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[Objective],
-                 valid_sets: Sequence[Dataset] = ()):
-        super().__init__(config, train_set, objective, valid_sets)
+                 valid_sets: Sequence[Dataset] = (), **kwargs):
+        super().__init__(config, train_set, objective, valid_sets, **kwargs)
         self._rng_drop = np.random.RandomState(config.drop_seed)
         self._tree_weight: List[float] = []  # per-iteration weights
         self._sum_weight = 0.0

@@ -81,11 +81,15 @@ class GBDT:
 
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[Objective],
-                 valid_sets: Sequence[Dataset] = ()):
+                 valid_sets: Sequence[Dataset] = (),
+                 init_row_scores: Optional[np.ndarray] = None,
+                 valid_init_row_scores: Sequence[np.ndarray] = (),
+                 num_init_iteration: int = 0):
         self.config = config
         self.train_set = train_set.construct()
         self.objective = objective
         self.iter_ = 0
+        self.num_init_iteration = num_init_iteration  # gbdt.h analog
         self.models: List[Tree] = []
         # (TreeArrays, weight) per trained tree, kept on device for DART
         # drop/restore, rollback and refit (HistogramPool-sized: ~KBs/tree)
@@ -137,18 +141,33 @@ class GBDT:
             if len(self._init_scores) != self.K:
                 self._init_scores = np.resize(self._init_scores, self.K)
 
-        self.scores = jnp.zeros((self.K, R), jnp.float32)
-        if self.config.boost_from_average and objective is not None:
-            self.scores = self.scores + jnp.asarray(
-                self._init_scores, jnp.float32)[:, None]
-            self._boosted_from_average = True
-        else:
+        if init_row_scores is not None:
+            # continued training (init_model): scores resume from the
+            # loaded model's per-row predictions; no BoostFromAverage
+            # (gbdt.cpp only boosts from average when models_.empty())
+            def to_kr(a, r_pad):
+                a = np.asarray(a, np.float32)
+                if a.ndim == 1:
+                    a = a[:, None]
+                return _pad_rows(a, r_pad).T  # [K, R]
+            self.scores = jnp.asarray(to_kr(init_row_scores, R))
+            self.valid_scores = [
+                jnp.asarray(to_kr(v, dd.r_pad))
+                for v, dd in zip(valid_init_row_scores, self.valid_dd)]
             self._init_scores = np.zeros(self.K)
-        self.valid_scores = [
-            jnp.zeros((self.K, dd.r_pad), jnp.float32)
-            + (jnp.asarray(self._init_scores, jnp.float32)[:, None]
-               if self._boosted_from_average else 0.0)
-            for dd in self.valid_dd]
+        else:
+            self.scores = jnp.zeros((self.K, R), jnp.float32)
+            if self.config.boost_from_average and objective is not None:
+                self.scores = self.scores + jnp.asarray(
+                    self._init_scores, jnp.float32)[:, None]
+                self._boosted_from_average = True
+            else:
+                self._init_scores = np.zeros(self.K)
+            self.valid_scores = [
+                jnp.zeros((self.K, dd.r_pad), jnp.float32)
+                + (jnp.asarray(self._init_scores, jnp.float32)[:, None]
+                   if self._boosted_from_average else 0.0)
+                for dd in self.valid_dd]
 
         # static metadata for the tree builder
         self.num_bins_pf = jnp.asarray(self.train_set.per_feature_num_bins())
@@ -362,11 +381,31 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self):
-        """RollbackOneIter (gbdt.cpp:454). Raises before mutating state —
-        full support needs per-tree leaf-assignment retention (planned)."""
-        raise NotImplementedError(
-            "rollback_one_iter requires per-tree partition retention; "
-            "planned alongside refit")
+        """RollbackOneIter (gbdt.cpp:454): subtract the last iteration's
+        trees from every score and drop them. Replays the host trees over
+        the binned matrix (threshold_bin traversal — the same decisions the
+        device builder made), so repeated rollbacks work without keeping
+        per-tree device state."""
+        if self.iter_ <= 0:
+            return
+        uf = self.train_set.used_features
+        nan_bins = np.asarray(self.nan_bin_pf)
+        bins_h = np.asarray(self.train_dd.bins)
+        vbins_h = [np.asarray(dd.bins) for dd in self.valid_dd]
+        for k in range(self.K):
+            tree = self.models[-(self.K - k)]
+            pred = tree.predict_binned(bins_h, uf, nan_bins)
+            self.scores = self.scores.at[k].add(
+                -jnp.asarray(pred, jnp.float32))
+            for vi, vb in enumerate(vbins_h):
+                vpred = tree.predict_binned(vb, uf, nan_bins)
+                self.valid_scores[vi] = self.valid_scores[vi].at[k].add(
+                    -jnp.asarray(vpred, jnp.float32))
+        for _ in range(self.K):
+            self.models.pop()
+            if self.keep_device_trees:
+                self.device_trees.pop()
+        self.iter_ -= 1
 
     # ------------------------------------------------------------------
     def get_training_scores(self) -> np.ndarray:

@@ -30,7 +30,7 @@ class RF(GBDT):
 
     def __init__(self, config: Config, train_set: Dataset,
                  objective: Optional[Objective],
-                 valid_sets: Sequence[Dataset] = ()):
+                 valid_sets: Sequence[Dataset] = (), **kwargs):
         if objective is None:
             raise ValueError("RF mode does not support custom objective "
                              "(rf.hpp Boosting check)")
@@ -39,7 +39,7 @@ class RF(GBDT):
             raise ValueError(
                 "RF needs bagging (bagging_freq > 0 and bagging_fraction "
                 "< 1) or feature_fraction < 1 (rf.hpp Init check)")
-        super().__init__(config, train_set, objective, valid_sets)
+        super().__init__(config, train_set, objective, valid_sets, **kwargs)
         self.shrinkage = 1.0
         # constant gradients at the init score (rf.hpp Boosting): RF never
         # boosts, every tree fits the same residuals
@@ -54,9 +54,13 @@ class RF(GBDT):
                 tmp_scores[0], self.label_dev, self.weight_dev)
             self._g0, self._h0 = g[None, :], h[None, :]
         # scores hold the running average of tree outputs, not a boosted
-        # sum; start from zero (bias rides inside each tree)
-        self.scores = jnp.zeros_like(self.scores)
-        self.valid_scores = [jnp.zeros_like(v) for v in self.valid_scores]
+        # sum; start from zero (bias rides inside each tree). For continued
+        # training the init_row_scores of an average_output base model are
+        # already averages, so they stand as-is (rf.hpp Init MultiplyScore).
+        if self.num_init_iteration == 0:
+            self.scores = jnp.zeros_like(self.scores)
+            self.valid_scores = [jnp.zeros_like(v)
+                                 for v in self.valid_scores]
 
     def _grads(self, it: int):
         return self._g0, self._h0
@@ -67,7 +71,7 @@ class RF(GBDT):
         cfg = self.config
         g, h, count_mask = self._sampling(self.iter_, self._g0, self._h0)
         fmask = self._feature_mask()
-        n = float(self.iter_)
+        n = float(self.iter_ + self.num_init_iteration)
         for k in range(self.K):
             gh = jnp.stack([g[k], h[k], count_mask], axis=1)
             tree_arrays, row_leaf, valid_rls = self._build_one_tree(gh, fmask)

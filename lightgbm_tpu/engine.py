@@ -40,6 +40,11 @@ class Booster:
         self._valid_names: List[str] = []
         self._gbdt: Optional[GBDT] = None
         self._trees: List[Tree] = []
+        # continued training (init_model): trees of the loaded base model
+        # (num_init_iteration of gbdt.h) + pending per-row init scores
+        self._base_trees: List[Tree] = []
+        self._pending_init_scores = None
+        self._pending_valid_init_scores: List = []
         self._num_class = 1
         self._objective_name = "regression"
         self._feature_names: List[str] = []
@@ -76,12 +81,49 @@ class Booster:
         self._max_feature_idx = train_set.num_total_features - 1
 
     # -- training ------------------------------------------------------
+    def _all_trees(self) -> List[Tree]:
+        return self._base_trees + self._trees
+
+    def _set_init_model(self, base: "Booster", train_scores=None,
+                        valid_scores=None):
+        """Continued training: resume scores from `base`'s predictions
+        (engine.py:234-246 _set_predictor / init-score flow). Score arrays
+        may be precomputed (train() does, before raw data is freed);
+        otherwise the datasets must still hold their raw matrices
+        (free_raw_data=False)."""
+        if self._gbdt is not None:
+            raise RuntimeError("init_model must be set before training")
+
+        def raw_of(ds: Dataset, what: str):
+            if ds._raw_data is None:
+                raise ValueError(
+                    f"Continued training needs the {what} raw data; "
+                    "construct the Dataset with free_raw_data=False")
+            return ds._raw_data
+        if train_scores is None:
+            train_scores = base.predict(raw_of(self.train_set, "training"),
+                                        raw_score=True)
+        if valid_scores is None:
+            valid_scores = [
+                base.predict(raw_of(vs, "validation"), raw_score=True)
+                for vs in self._valid_sets]
+        self._pending_init_scores = train_scores
+        self._pending_valid_init_scores = list(valid_scores)
+        self._base_trees = [copy.deepcopy(t) for t in base._all_trees()]
+        self._average_output = base._average_output
+
     def _ensure_gbdt(self):
         if self._gbdt is None:
-            self._gbdt = create_boosting(self.config, self.train_set,
-                                         self._objective, self._valid_sets)
-            self._average_output = getattr(self._gbdt, "average_output",
-                                           False)
+            self._gbdt = create_boosting(
+                self.config, self.train_set, self._objective,
+                self._valid_sets,
+                init_row_scores=self._pending_init_scores,
+                valid_init_row_scores=self._pending_valid_init_scores,
+                num_init_iteration=(len(self._base_trees)
+                                    // max(1, self._num_class)))
+            if not self._base_trees:
+                self._average_output = getattr(
+                    self._gbdt, "average_output", False)
             self._trees = self._gbdt.models
             for m in self._metrics:
                 m.init(self.train_set.get_label(),
@@ -129,6 +171,63 @@ class Booster:
         self.config.set(**params)
         if self._gbdt is not None:
             self._gbdt.shrinkage = self.config.learning_rate
+
+    def rollback_one_iter(self):
+        """Undo the newest iteration (LGBM_BoosterRollbackOneIter /
+        gbdt.cpp:454)."""
+        self._ensure_gbdt()
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """New Booster with this model's tree STRUCTURES and leaf values
+        re-fit to `data`/`label` (basic.py Booster.refit +
+        gbdt.cpp:258 RefitTree + serial_tree_learner.cpp:248
+        FitByExistingTree): per tree, gradients at the running score,
+        per-leaf grad/hess sums, new output = decay*old +
+        (1-decay)*shrinkage*CalculateSplittedLeafOutput."""
+        from .ops.split import leaf_output as _leaf_output_fn
+        import jax.numpy as jnp
+
+        X = self._as_matrix(data)
+        y = np.asarray(label, np.float64).reshape(-1)
+        cfg = Config(self.params)
+        objective = create_objective(cfg)
+        if objective is None:
+            raise ValueError("Cannot refit with a custom objective")
+        new_booster = Booster(model_str=self.model_to_string(),
+                              params=dict(self.params))
+        trees = new_booster._all_trees()
+        K = max(1, self._num_class)
+        objective.init(y, kwargs.get("weight"), None)
+        scores = np.zeros((len(y), K), np.float64)
+        l1, l2 = cfg.lambda_l1, cfg.lambda_l2
+        for it in range(len(trees) // K):
+            # gradients at the current cumulative score (RefitTree loop)
+            for k in range(K):
+                tree = trees[it * K + k]
+                if K > 1:
+                    g, h = objective.get_gradients(
+                        jnp.asarray(scores, jnp.float32),
+                        jnp.asarray(y, jnp.float32), None)
+                    g, h = np.asarray(g)[:, k], np.asarray(h)[:, k]
+                else:
+                    g, h = objective.get_gradients(
+                        jnp.asarray(scores[:, 0], jnp.float32),
+                        jnp.asarray(y, jnp.float32), None)
+                    g, h = np.asarray(g), np.asarray(h)
+                leaves = tree.predict_leaf_index(X)
+                nl = tree.num_leaves
+                sg = np.bincount(leaves, weights=g, minlength=nl)
+                sh = np.bincount(leaves, weights=h, minlength=nl) + 1e-15
+                new_out = np.asarray(_leaf_output_fn(
+                    jnp.asarray(sg), jnp.asarray(sh), l1, l2,
+                    cfg.max_delta_step)) * tree.shrinkage
+                tree.leaf_value = (decay_rate * tree.leaf_value
+                                   + (1.0 - decay_rate) * new_out)
+                scores[:, k] += tree.leaf_value[leaves]
+        return new_booster
 
     # -- evaluation ----------------------------------------------------
     def _converted(self, raw: np.ndarray) -> np.ndarray:
@@ -185,7 +284,7 @@ class Booster:
                 f"({self._max_feature_idx + 1}).\nYou can set "
                 "predict_disable_shape_check=true to discard this error")
         K = max(1, self._num_class)
-        trees = self._trees
+        trees = self._all_trees()
         if num_iteration is None or num_iteration < 0:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else
@@ -197,8 +296,17 @@ class Booster:
             out = np.stack([t.predict_leaf_index(X) for t in use], axis=1)
             return out
         if pred_contrib:
-            raise NotImplementedError(
-                "SHAP contributions are planned (tree.h:141 parity item)")
+            # TreeSHAP (tree.h:141 PredictContrib): per-class
+            # [n, n_features+1] blocks, last column = expected value
+            nf = X.shape[1]
+            out = np.zeros((X.shape[0], K * (nf + 1)))
+            for i, t in enumerate(use):
+                k = (lo + i) % K
+                out[:, k * (nf + 1):(k + 1) * (nf + 1)] += \
+                    t.predict_contrib(X)
+            if self._average_output and use:
+                out /= len(use) // K
+            return out
         raw = np.zeros((X.shape[0], K))
         for i, t in enumerate(use):
             raw[:, (lo + i) % K] += t.predict(X)
@@ -226,7 +334,7 @@ class Booster:
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:
         K = max(1, self._num_class)
-        trees = self._trees
+        trees = self._all_trees()
         if num_iteration is not None and num_iteration > 0:
             trees = trees[: num_iteration * K]
         header = [
@@ -328,10 +436,10 @@ class Booster:
 
     # -- introspection -------------------------------------------------
     def num_trees(self) -> int:
-        return len(self._trees)
+        return len(self._all_trees())
 
     def current_iteration(self) -> int:
-        return len(self._trees) // max(1, self._num_class)
+        return len(self._all_trees()) // max(1, self._num_class)
 
     def num_feature(self) -> int:
         return self._max_feature_idx + 1
@@ -343,7 +451,7 @@ class Booster:
                            iteration: Optional[int] = None) -> np.ndarray:
         nf = self._max_feature_idx + 1
         out = np.zeros(nf)
-        for t in self._trees:
+        for t in self._all_trees():
             if importance_type == "gain":
                 out += t.feature_importance_gain(nf)
             else:
@@ -376,6 +484,24 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         fobj = params["objective"]
         params["objective"] = "custom"
 
+    # continued training: predict init scores BEFORE Dataset.construct
+    # frees the raw matrices (predictor flow of engine.py:234-246)
+    base = None
+    base_train_scores = None
+    base_valid_scores = None
+    if init_model is not None:
+        base = (init_model if isinstance(init_model, Booster)
+                else Booster(model_file=str(init_model)))
+        if train_set._raw_data is None:
+            raise ValueError(
+                "init_model needs the training Dataset's raw data; use "
+                "free_raw_data=False or an unconstructed Dataset")
+        base_train_scores = base.predict(train_set._raw_data,
+                                         raw_score=True)
+        base_valid_scores = [
+            base.predict(vs._raw_data, raw_score=True)
+            for vs in (valid_sets or []) if vs is not train_set]
+
     booster = Booster(params=params, train_set=train_set)
     if valid_sets:
         valid_names = list(valid_names or [])
@@ -384,6 +510,8 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                 continue  # training data is evaluated anyway
             name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
             booster.add_valid(vs, name)
+    if base is not None:
+        booster._set_init_model(base, base_train_scores, base_valid_scores)
 
     callbacks = list(callbacks or [])
     if cfg.early_stopping_round and cfg.early_stopping_round > 0:

@@ -200,6 +200,55 @@ class Tree:
         return self._traverse(X)
 
     # ------------------------------------------------------------------
+    def _traverse_binned(self, bins: np.ndarray, used_features: np.ndarray,
+                         nan_bins: np.ndarray) -> np.ndarray:
+        """Leaf index per BINNED row (threshold_bin comparison — the same
+        decisions the on-device builder made). Only valid for trees built
+        in-session (threshold_bin populated); used by rollback/refit score
+        replay without needing the raw feature matrix.
+
+        bins: [R, F_local] over used features; used_features maps local ->
+        global; nan_bins: [F_local] nan bin per local feature (-1 none).
+        """
+        global_to_local = {int(g): i for i, g in enumerate(used_features)}
+        n = bins.shape[0]
+        if self.num_leaves == 1:
+            return np.zeros(n, np.int32)
+        node = np.zeros(n, np.int32)
+        active = np.ones(n, bool)
+        out = np.zeros(n, np.int32)
+        feat_local = np.asarray(
+            [global_to_local[int(f)] for f in self.split_feature], np.int32)
+        for _ in range(self.num_leaves):
+            if not active.any():
+                break
+            idx = node[active]
+            fl = feat_local[idx]
+            v = bins[active, fl]
+            dt = self.decision_type[idx]
+            is_cat = (dt & _CAT_BIT) != 0
+            thr = self.threshold_bin[idx]
+            nb = nan_bins[fl]
+            isnan = (v == nb) & (nb >= 0)
+            go_left = np.where(is_cat, v == thr, v <= thr)
+            defl = (dt & _DEFAULT_LEFT_BIT) != 0
+            go_left = np.where(isnan & ~is_cat, defl, go_left)
+            nxt = np.where(go_left, self.left_child[idx],
+                           self.right_child[idx])
+            node[active] = nxt
+            leaf_now = nxt < 0
+            act_idx = np.nonzero(active)[0]
+            done = act_idx[leaf_now]
+            out[done] = ~nxt[leaf_now]
+            active[done] = False
+        return out
+
+    def predict_binned(self, bins: np.ndarray, used_features: np.ndarray,
+                       nan_bins: np.ndarray) -> np.ndarray:
+        return self.leaf_value[
+            self._traverse_binned(bins, used_features, nan_bins)]
+
+    # ------------------------------------------------------------------
     def to_text(self, tree_id: int) -> str:
         """One ``Tree=<id>`` block (gbdt_model_text.cpp:311 format)."""
         def join(a, fmt="{}"):
@@ -272,6 +321,122 @@ class Tree:
                                       kv["cat_threshold"].split()]
         tree.shrinkage = float(kv.get("shrinkage", "1"))
         return tree
+
+    # ------------------------------------------------------------------
+    # SHAP contributions (tree.h:141 PredictContrib — the TreeExplainer
+    # path-integration algorithm of Lundberg et al., as in tree.cpp
+    # TreeSHAP; recursion over the node arrays with EXTEND/UNWIND over
+    # the unique feature path)
+    def expected_value(self) -> float:
+        total = self.leaf_count.sum()
+        if total <= 0:
+            return float(self.leaf_value.mean())
+        return float((self.leaf_value * self.leaf_count).sum() / total)
+
+    def _node_weight(self, node: int) -> float:
+        """Row count reaching a node (internal idx >=0, leaf via ~idx)."""
+        if node >= 0:
+            return float(self.internal_count[node])
+        return float(self.leaf_count[~node])
+
+    def predict_contrib(self, X: np.ndarray) -> np.ndarray:
+        """[n, num_features_used + 1] SHAP values (last column = expected
+        value). Features indexed globally by split_feature."""
+        n, F = X.shape
+        out = np.zeros((n, F + 1))
+        out[:, -1] = self.expected_value()
+        if self.num_leaves == 1:
+            return out
+        for r in range(n):
+            self._tree_shap(X[r], out[r], 0, 1.0, 1.0, -1, [])
+        return out
+
+    def _decision(self, node: int, x: np.ndarray) -> bool:
+        f = self.split_feature[node]
+        dt = int(self.decision_type[node])
+        v = x[f]
+        if dt & _CAT_BIT:
+            if np.isnan(v) or v < 0:
+                return False
+            c = int(v)
+            cat_idx = int(self.threshold[node])
+            lo, hi = self.cat_boundaries[cat_idx], \
+                self.cat_boundaries[cat_idx + 1]
+            w = c // 32
+            return (w < hi - lo) and bool(
+                (self.cat_threshold[lo + w] >> (c % 32)) & 1)
+        if np.isnan(v):
+            if _missing_from_decision(dt) == MISSING_NAN:
+                return bool(dt & _DEFAULT_LEFT_BIT)
+            v = 0.0
+        return v <= self.threshold[node]
+
+    def _tree_shap(self, x, phi, node, p_zero, p_one, p_feat, path):
+        # path: list of [feat, zero_frac, one_frac, pweight]; elements are
+        # deep-copied — EXTEND mutates weights and the hot/cold branches
+        # must not see each other's updates
+        path = [list(p) for p in path] + \
+            [[p_feat, p_zero, p_one, 1.0 if len(path) == 0 else 0.0]]
+        # EXTEND
+        for i in range(len(path) - 2, -1, -1):
+            path[i + 1][3] += p_one * path[i][3] * (i + 1) / len(path)
+            path[i][3] = p_zero * path[i][3] * (len(path) - 1 - i) \
+                / len(path)
+        if node < 0:  # leaf
+            leaf_val = self.leaf_value[~node]
+            for i in range(1, len(path)):
+                # UNWIND sum of pweights excluding element i
+                total = 0.0
+                onew, zerow = path[i][2], path[i][1]
+                pw = list(p[3] for p in path)
+                k = len(path) - 1
+                tmp = pw[k]
+                for j in range(k - 1, -1, -1):
+                    if onew != 0:
+                        t = tmp * (k + 1) / ((j + 1) * onew)
+                        total += t
+                        tmp = pw[j] - t * zerow * (k - j) / (k + 1)
+                    else:
+                        total += pw[j] / (zerow * (k - j) / (k + 1))
+                phi[path[i][0]] += total * (onew - zerow) * leaf_val
+            return
+        hot, cold = ((self.left_child[node], self.right_child[node])
+                     if self._decision(node, x)
+                     else (self.right_child[node], self.left_child[node]))
+        w = self._node_weight(node)
+        hot_zero = self._node_weight(hot) / w if w > 0 else 0.0
+        cold_zero = self._node_weight(cold) / w if w > 0 else 0.0
+        f = int(self.split_feature[node])
+        # if f already on path, unwind its previous occurrence
+        incoming_zero, incoming_one = 1.0, 1.0
+        prev = next((i for i in range(len(path))
+                     if path[i][0] == f), None)
+        if prev is not None:
+            incoming_zero, incoming_one = path[prev][1], path[prev][2]
+            path = self._unwind(path, prev)
+        self._tree_shap(x, phi, hot, incoming_zero * hot_zero,
+                        incoming_one, f, path)
+        self._tree_shap(x, phi, cold, incoming_zero * cold_zero,
+                        0.0, f, path)
+
+    @staticmethod
+    def _unwind(path, i):
+        path = [list(p) for p in path]
+        k = len(path) - 1
+        onew, zerow = path[i][2], path[i][1]
+        tmp = path[k][3]
+        for j in range(k - 1, -1, -1):
+            if onew != 0:
+                t = tmp * (k + 1) / ((j + 1) * onew)
+                tmp = path[j][3] - t * zerow * (k - j) / (k + 1)
+                path[j][3] = t
+            else:
+                path[j][3] = path[j][3] * (k + 1) / (zerow * (k - j))
+        for j in range(i, k):
+            path[j][0] = path[j + 1][0]
+            path[j][1] = path[j + 1][1]
+            path[j][2] = path[j + 1][2]
+        return path[:-1]
 
     def scale(self, factor: float):
         """Shrinkage(rate) (tree.h): rescale every output in place —

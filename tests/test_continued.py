@@ -1,0 +1,108 @@
+"""Continued training (init_model), rollback, refit
+(reference test_engine.py continued-training / refit coverage model)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n=3000, f=8):
+    X = rng.normal(size=(n, f))
+    logit = X[:, 0] * 1.2 - 0.8 * X[:, 1] ** 2 + np.sin(X[:, 2])
+    y = (logit + 0.3 * rng.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "metric": "binary_logloss"}
+
+
+def test_init_model_continues_training(rng):
+    X, y = _data(rng)
+    ds1 = lgb.Dataset(X, label=y, free_raw_data=False)
+    base = lgb.train(PARAMS, ds1, 10)
+    ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    cont = lgb.train(PARAMS, ds2, 10, init_model=base)
+    assert cont.num_trees() == 20
+    assert cont.current_iteration() == 20
+    # 10+10 continued must match internal scores (resume arithmetic)
+    raw_model = cont.predict(X, raw_score=True)
+    raw_internal = cont._gbdt.eval_scores(-1)[:, 0]
+    base_raw = base.predict(X, raw_score=True)
+    new_part = sum(t.predict(X) for t in cont._trees)
+    np.testing.assert_allclose(raw_model, base_raw + new_part, rtol=1e-6)
+    np.testing.assert_allclose(raw_internal, raw_model, rtol=2e-4,
+                               atol=2e-4)
+    # and it should improve on the base model's logloss
+    eps = 1e-7
+    ll = lambda p: -np.mean(y * np.log(p + eps) + (1 - y) *
+                            np.log(1 - p + eps))
+    assert ll(cont.predict(X)) < ll(base.predict(X))
+
+
+def test_init_model_from_file(rng, tmp_path):
+    X, y = _data(rng, n=1000)
+    ds1 = lgb.Dataset(X, label=y, free_raw_data=False)
+    base = lgb.train(PARAMS, ds1, 5)
+    path = str(tmp_path / "m.txt")
+    base.save_model(path)
+    ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    cont = lgb.train(PARAMS, ds2, 5, init_model=path)
+    assert cont.num_trees() == 10
+
+
+def test_init_model_requires_raw(rng):
+    X, y = _data(rng, n=500)
+    base = lgb.train(PARAMS, lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    ds = lgb.Dataset(X, label=y)  # raw freed on construct
+    ds.construct()
+    with pytest.raises(ValueError, match="raw data"):
+        lgb.train(PARAMS, ds, 3, init_model=base)
+
+
+def test_rollback_one_iter(rng):
+    X, y = _data(rng)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(PARAMS, ds, 8)
+    before = bst._gbdt.eval_scores(-1)[:, 0].copy()
+    bst.rollback_one_iter()
+    assert bst.num_trees() == 7
+    after = bst._gbdt.eval_scores(-1)[:, 0]
+    assert not np.allclose(before, after)
+    # rolled-back scores == model with 7 trees
+    raw7 = bst.predict(X, raw_score=True, num_iteration=7)
+    np.testing.assert_allclose(after, raw7, rtol=2e-4, atol=2e-4)
+    # rollback twice then keep training still works
+    bst.rollback_one_iter()
+    assert bst.num_trees() == 6
+    bst.update()
+    assert bst.num_trees() == 7
+    raw_model = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(bst._gbdt.eval_scores(-1)[:, 0], raw_model,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_refit(rng):
+    X, y = _data(rng)
+    # a genuinely shifted task: same structures, opposite label surface
+    X2, y2raw = _data(np.random.RandomState(99))
+    y2 = 1.0 - y2raw
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(PARAMS, ds, 10)
+    ref = bst.refit(X2, y2, decay_rate=0.1)
+    # structures identical, leaf values changed
+    assert ref.num_trees() == bst.num_trees()
+    t0, r0 = bst._all_trees()[3], ref._all_trees()[3]
+    np.testing.assert_array_equal(t0.split_feature, r0.split_feature)
+    np.testing.assert_array_equal(t0.threshold, r0.threshold)
+    assert not np.allclose(t0.leaf_value, r0.leaf_value)
+    # refit with decay 1.0 is a no-op on the values
+    same = bst.refit(X2, y2, decay_rate=1.0)
+    np.testing.assert_allclose(same.predict(X), bst.predict(X), rtol=1e-6)
+    # refit toward the new data should beat the old model there
+    eps = 1e-7
+    ll = lambda b, Xa, ya: -np.mean(
+        ya * np.log(b.predict(Xa) + eps)
+        + (1 - ya) * np.log(1 - b.predict(Xa) + eps))
+    assert ll(ref, X2, y2) < ll(bst, X2, y2)

@@ -1,0 +1,56 @@
+"""SHAP contributions (tree.h:141 PredictContrib parity).
+
+Local-accuracy property: contributions (incl. expected-value column) must
+sum exactly to the raw prediction — the invariant the reference's
+TreeExplainer guarantees.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def test_local_accuracy_binary(rng):
+    X = rng.normal(size=(600, 6))
+    y = (X[:, 0] - 0.5 * X[:, 1] ** 2 + 0.2 * rng.normal(size=600) > 0
+         ).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, 10)
+    contrib = bst.predict(X[:50], pred_contrib=True)
+    assert contrib.shape == (50, 7)
+    raw = bst.predict(X[:50], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-6,
+                               atol=1e-6)
+    # feature 0 and 1 drive the label; they should dominate attributions
+    mean_abs = np.abs(contrib[:, :6]).mean(axis=0)
+    assert mean_abs[:2].sum() > mean_abs[2:].sum()
+
+
+def test_local_accuracy_regression_with_nan(rng):
+    X = rng.normal(size=(500, 5))
+    X[rng.rand(500) < 0.2, 2] = np.nan
+    y = np.where(np.isnan(X[:, 2]), 1.5, X[:, 2]) + X[:, 0]
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, ds, 8)
+    contrib = bst.predict(X[:64], pred_contrib=True)
+    raw = bst.predict(X[:64], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_multiclass_contrib_shape(rng):
+    X = rng.normal(size=(400, 4))
+    y = np.argmax(X[:, :3], axis=1).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1}, ds, 5)
+    contrib = bst.predict(X[:20], pred_contrib=True)
+    assert contrib.shape == (20, 3 * 5)
+    raw = bst.predict(X[:20], raw_score=True)
+    for k in range(3):
+        np.testing.assert_allclose(
+            contrib[:, k * 5:(k + 1) * 5].sum(axis=1), raw[:, k],
+            rtol=1e-5, atol=1e-5)
