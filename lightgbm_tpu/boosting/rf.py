@@ -34,13 +34,25 @@ class RF(GBDT):
         if objective is None:
             raise ValueError("RF mode does not support custom objective "
                              "(rf.hpp Boosting check)")
-        if not ((config.bagging_freq > 0 and 0 < config.bagging_fraction < 1)
+        if config.data_sample_strategy == "bagging" and not (
+                (config.bagging_freq > 0 and 0 < config.bagging_fraction < 1)
                 or 0 < config.feature_fraction < 1):
+            # rf.hpp Init: bagging strategy needs actual subsampling;
+            # the goss strategy is accepted as-is (CHECK_EQ else-branch)
             raise ValueError(
                 "RF needs bagging (bagging_freq > 0 and bagging_fraction "
                 "< 1) or feature_fraction < 1 (rf.hpp Init check)")
         super().__init__(config, train_set, objective, valid_sets, **kwargs)
         self.shrinkage = 1.0
+        if self.num_init_iteration > 0 and config.boost_from_average:
+            # rf.hpp Boosting recomputes BoostFromAverage regardless of
+            # num_init_iteration: continued-RF gradients are taken at the
+            # label-average init score and new trees carry it as AddBias
+            # (GBDT.__init__ zeroes _init_scores on the init_row_scores
+            # path — that is the boosted-sum semantic, not RF's)
+            self._init_scores = np.resize(np.asarray(
+                self.objective.boost_from_score(),
+                np.float64).reshape(-1), self.K)
         # constant gradients at the init score (rf.hpp Boosting): RF never
         # boosts, every tree fits the same residuals
         init = jnp.asarray(self._init_scores, jnp.float32)[:, None]
@@ -108,3 +120,37 @@ class RF(GBDT):
 
         self.iter_ += 1
         return False  # RF never early-stops (rf.hpp TrainOneIter)
+
+    def rollback_one_iter(self):
+        """RF::RollbackOneIter (rf.hpp:184-203): scores are running
+        AVERAGES, so undoing iteration n is Shrinkage(-1) +
+        MultiplyScore(n) + AddScore + MultiplyScore(1/(n-1)), i.e.
+        scores = (scores * n - tree_pred) / (n - 1) — NOT the boosted-sum
+        subtraction GBDT does."""
+        if self.iter_ <= 0:
+            return
+        n = float(self.iter_ + self.num_init_iteration)
+        uf = self.train_set.used_features
+        nan_bins = np.asarray(self.nan_bin_pf)
+        bins_h = np.asarray(self.train_dd.bins)
+        vbins_h = [np.asarray(dd.bins) for dd in self.valid_dd]
+        for k in range(self.K):
+            tree = self.models[-(self.K - k)]
+            pred = jnp.asarray(tree.predict_binned(bins_h, uf, nan_bins),
+                               jnp.float32)
+            if n > 1:
+                new = (self.scores[k] * n - pred) / (n - 1.0)
+            else:
+                new = jnp.zeros_like(self.scores[k])
+            self.scores = self.scores.at[k].set(new)
+            for vi, vb in enumerate(vbins_h):
+                vpred = jnp.asarray(tree.predict_binned(vb, uf, nan_bins),
+                                    jnp.float32)
+                if n > 1:
+                    vnew = (self.valid_scores[vi][k] * n - vpred) / (n - 1.0)
+                else:
+                    vnew = jnp.zeros_like(self.valid_scores[vi][k])
+                self.valid_scores[vi] = self.valid_scores[vi].at[k].set(vnew)
+        for _ in range(self.K):
+            self.models.pop()
+        self.iter_ -= 1

@@ -367,8 +367,10 @@ class Config:
     def check_param_conflict(self):
         """Analog of Config::CheckParamConflict (config.h:1167)."""
         v = self._values
-        if v.get("boosting") == "rf":
-            # rf.hpp Init: bagging OR feature sampling qualifies
+        if v.get("boosting") == "rf" \
+                and self.data_sample_strategy == "bagging":
+            # rf.hpp Init: with the bagging strategy, bagging OR feature
+            # sampling qualifies; the goss strategy is accepted as-is
             has_bag = (self.bagging_freq > 0
                        and 0 < self.bagging_fraction < 1)
             has_ff = 0 < self.feature_fraction < 1
@@ -376,8 +378,6 @@ class Config:
                 raise ValueError(
                     "rf boosting requires bagging (bagging_freq > 0 and "
                     "0 < bagging_fraction < 1) or feature_fraction < 1")
-        if self.data_sample_strategy == "goss" and v.get("boosting") == "rf":
-            raise ValueError("goss sampling cannot be used with rf boosting")
         if self.objective in ("multiclass", "multiclassova") \
                 and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objective")

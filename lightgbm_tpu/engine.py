@@ -498,9 +498,16 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                 "free_raw_data=False or an unconstructed Dataset")
         base_train_scores = base.predict(train_set._raw_data,
                                          raw_score=True)
-        base_valid_scores = [
-            base.predict(vs._raw_data, raw_score=True)
-            for vs in (valid_sets or []) if vs is not train_set]
+        base_valid_scores = []
+        for vs in (valid_sets or []):
+            if vs is train_set:
+                continue
+            if vs._raw_data is None:
+                raise ValueError(
+                    "init_model needs each validation Dataset's raw data; "
+                    "use free_raw_data=False or an unconstructed Dataset")
+            base_valid_scores.append(base.predict(vs._raw_data,
+                                                  raw_score=True))
 
     booster = Booster(params=params, train_set=train_set)
     if valid_sets:
@@ -532,8 +539,15 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-    for i in range(num_boost_round):
-        env_before = CallbackEnv(booster, params, i, 0, num_boost_round, None)
+    # continued training iterates [init_iteration, init_iteration + rounds)
+    # (reference engine.py:309 `range(init_iteration, init_iteration +
+    # num_boost_round)`) so best_iteration indexes the FULL ensemble —
+    # predict()'s _all_trees() slice depends on this.
+    init_iteration = booster.current_iteration()
+    end_iteration = init_iteration + num_boost_round
+    for i in range(init_iteration, end_iteration):
+        env_before = CallbackEnv(booster, params, i, init_iteration,
+                                 end_iteration, None)
         for cb in callbacks_before:
             cb(env_before)
         stop = booster.update(fobj=fobj)
@@ -543,7 +557,8 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             if cfg.is_provide_training_metric:
                 evals.extend(booster.eval_train(feval))
             evals.extend(booster.eval_valid(feval))
-        env = CallbackEnv(booster, params, i, 0, num_boost_round, evals)
+        env = CallbackEnv(booster, params, i, init_iteration, end_iteration,
+                          evals)
         try:
             for cb in callbacks_after:
                 cb(env)

@@ -106,3 +106,87 @@ def test_refit(rng):
         ya * np.log(b.predict(Xa) + eps)
         + (1 - ya) * np.log(1 - b.predict(Xa) + eps))
     assert ll(ref, X2, y2) < ll(bst, X2, y2)
+
+
+def test_continued_early_stopping_offsets_best_iteration(rng):
+    """ADVICE r1 (high): with init_model, best_iteration must index the
+    FULL ensemble (reference engine.py:309 iterates from init_iteration),
+    so predict()'s best_iteration slice keeps the base model's tail."""
+    X, y = _data(rng)
+    Xv, yv = _data(np.random.RandomState(7))
+    ds1 = lgb.Dataset(X, label=y, free_raw_data=False)
+    base = lgb.train(PARAMS, ds1, 10)
+    ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    dv = lgb.Dataset(Xv, label=yv, free_raw_data=False, reference=ds2)
+    params = dict(PARAMS, early_stopping_round=3)
+    cont = lgb.train(params, ds2, 30, valid_sets=[dv], init_model=base)
+    # best_iteration counts base iterations too
+    assert cont.best_iteration > 10 or cont.best_iteration == -1
+    if cont.best_iteration > 0:
+        # default predict uses best_iteration trees of the full ensemble
+        pred_best = cont.predict(X, raw_score=True)
+        pred_explicit = cont.predict(X, raw_score=True,
+                                     num_iteration=cont.best_iteration)
+        np.testing.assert_allclose(pred_best, pred_explicit)
+        # and must include the whole base model's contribution
+        base_raw = base.predict(X, raw_score=True)
+        n_new = cont.best_iteration - 10
+        new_part = sum(t.predict(X) for t in cont._trees[:n_new])
+        np.testing.assert_allclose(pred_best, base_raw + new_part,
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_rf_rollback_preserves_average(rng):
+    """ADVICE r1 (medium): RF scores are running averages; rollback must
+    be (scores*n - pred)/(n-1) (rf.hpp:184-203), not GBDT subtraction."""
+    X, y = _data(rng, n=1500)
+    params = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+              "bagging_freq": 1, "bagging_fraction": 0.7, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(params, ds, 5)
+    bst.rollback_one_iter()
+    assert bst.num_trees() == 4
+    # internal scores must equal the average of the remaining 4 trees
+    internal = bst._gbdt.eval_scores(-1)[:, 0]
+    avg = np.mean([t.predict(X) for t in bst._trees], axis=0)
+    np.testing.assert_allclose(internal, avg, rtol=2e-4, atol=2e-4)
+    # training after rollback stays consistent
+    bst.update()
+    internal = bst._gbdt.eval_scores(-1)[:, 0]
+    avg = np.mean([t.predict(X) for t in bst._trees], axis=0)
+    np.testing.assert_allclose(internal, avg, rtol=2e-4, atol=2e-4)
+
+
+def test_rf_goss_allowed(rng):
+    """ADVICE r1 (low): rf + goss is supported by the reference
+    (rf.hpp Init CHECK_EQ else-branch)."""
+    X, y = _data(rng, n=1500)
+    params = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+              "data_sample_strategy": "goss", "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(params, ds, 3)
+    assert bst.num_trees() == 3
+    # model trains to something sensible
+    pred = bst.predict(X)
+    assert np.all((pred >= 0) & (pred <= 1))
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, pred) > 0.7
+
+
+def test_continued_rf_uses_boost_from_average(rng):
+    """ADVICE r1 (low): continued RF recomputes BoostFromAverage (rf.hpp
+    Boosting runs in Init regardless of num_init_iteration)."""
+    X, y = _data(rng, n=1500)
+    params = {"objective": "binary", "boosting": "rf", "num_leaves": 15,
+              "bagging_freq": 1, "bagging_fraction": 0.7, "verbosity": -1}
+    ds1 = lgb.Dataset(X, label=y, free_raw_data=False)
+    base = lgb.train(params, ds1, 3)
+    ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    cont = lgb.train(params, ds2, 3, init_model=base)
+    assert cont.num_trees() == 6
+    # gradients were taken at the label-average init score, not 0
+    assert abs(cont._gbdt._init_scores[0]) > 1e-6
+    # prediction = average over all 6 trees, consistent with internals
+    internal = cont._gbdt.eval_scores(-1)[:, 0]
+    avg = np.mean([t.predict(X) for t in cont._all_trees()], axis=0)
+    np.testing.assert_allclose(internal, avg, rtol=2e-4, atol=2e-4)
