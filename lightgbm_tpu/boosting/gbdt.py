@@ -182,7 +182,25 @@ class GBDT:
             min_gain_to_split=float(config.min_gain_to_split),
             cat_l2=float(config.cat_l2),
             cat_smooth=float(config.cat_smooth),
-            max_delta_step=float(config.max_delta_step))
+            max_delta_step=float(config.max_delta_step),
+            path_smooth=float(config.path_smooth),
+            monotone_penalty=float(config.monotone_penalty),
+            extra_trees=bool(config.extra_trees),
+            max_cat_threshold=int(config.max_cat_threshold),
+            max_cat_to_onehot=int(config.max_cat_to_onehot),
+            min_data_per_group=float(config.min_data_per_group))
+
+        self.mono_type_pf = self._parse_monotone_constraints()
+        self.interaction_groups = self._parse_interaction_constraints()
+        # replicated PRNG driving per-node feature sampling (ColSampler,
+        # feature_fraction_seed) and extra-trees thresholds (extra_seed)
+        self._ffbn = float(config.feature_fraction_bynode)
+        if self._ffbn < 1.0 or config.extra_trees:
+            seed = (int(config.feature_fraction_seed) * 2654435761
+                    + int(config.extra_seed)) & 0x7FFFFFFF
+            self._tree_key = jax.random.PRNGKey(seed)
+        else:
+            self._tree_key = None
 
         self._rng_feature = np.random.RandomState(config.feature_fraction_seed)
         self._rng_bagging = np.random.RandomState(config.bagging_seed)
@@ -194,6 +212,74 @@ class GBDT:
 
         self._update_score_jit = jax.jit(self._update_score_impl)
         self._goss_jit = jax.jit(self._goss_impl)
+
+    # ------------------------------------------------------------------
+    def _parse_monotone_constraints(self) -> Optional[jax.Array]:
+        """[F_used] int32 in {-1,0,1} or None (config.h monotone_constraints;
+        applied via BasicLeafConstraints semantics — basic mode only)."""
+        mc = self.config.monotone_constraints
+        if not mc:
+            return None
+        if isinstance(mc, str):
+            mc = [int(x) for x in mc.replace("(", "").replace(")", "")
+                  .split(",")]
+        mc = np.asarray(list(mc), np.int32)
+        ntf = self.train_set.num_total_features
+        if len(mc) != ntf:
+            raise ValueError(
+                f"monotone_constraints has {len(mc)} entries but the "
+                f"dataset has {ntf} features")
+        if not np.isin(mc, (-1, 0, 1)).all():
+            raise ValueError("monotone_constraints values must be in "
+                             "{-1, 0, 1}")
+        used = mc[self.train_set.used_features]
+        if not used.any():
+            return None
+        is_cat = np.asarray(self.train_set.per_feature_is_categorical())
+        if (used != 0)[is_cat].any():
+            raise ValueError("monotone_constraints cannot be used with "
+                             "categorical features (config.cpp check)")
+        method = self.config.monotone_constraints_method
+        if method not in ("basic", "intermediate", "advanced"):
+            raise ValueError(f"unknown monotone_constraints_method {method}")
+        if method != "basic":
+            raise NotImplementedError(
+                f"monotone_constraints_method={method} is not implemented "
+                "yet; use 'basic' (monotone_constraints.hpp:516,858 modes "
+                "are planned)")
+        return jnp.asarray(used)
+
+    def _parse_interaction_constraints(self) -> Optional[jax.Array]:
+        """[G, F_used] bool group matrix or None (col_sampler.hpp:28
+        interaction_constraints_vector)."""
+        ic = self.config.interaction_constraints
+        if not ic:
+            return None
+        if isinstance(ic, str):
+            import json
+            s = ic.strip().replace("(", "[").replace(")", "]")
+            try:
+                parsed = json.loads(s)
+            except json.JSONDecodeError:
+                parsed = json.loads("[" + s + "]")
+            if parsed and all(isinstance(x, (int, float)) for x in parsed):
+                parsed = [parsed]  # single flat group
+            ic = parsed
+        groups = [list(g) for g in ic]
+        ntf = self.train_set.num_total_features
+        F = self.train_set.num_features
+        used_pos = {f: i for i, f in enumerate(self.train_set.used_features)}
+        mat = np.zeros((len(groups), F), bool)
+        for gi, g in enumerate(groups):
+            for f in g:
+                f = int(f)
+                if f < 0 or f >= ntf:
+                    raise ValueError(
+                        f"interaction_constraints feature index {f} out of "
+                        f"range [0, {ntf})")
+                if f in used_pos:
+                    mat[gi, used_pos[f]] = True
+        return jnp.asarray(mat)
 
     # ------------------------------------------------------------------
     def _grads(self, it: int) -> Tuple[jax.Array, jax.Array]:
@@ -291,11 +377,17 @@ class GBDT:
             return jnp.asarray(_pad_rows(a.T, R)).T
         return prep(gradients), prep(hessians)
 
-    def _build_one_tree(self, gh: jax.Array, fmask: jax.Array):
+    def _build_one_tree(self, gh: jax.Array, fmask: jax.Array, k: int = 0):
         """One tree on the current gradients; returns device results."""
         cfg = self.config
         builder = (self.plan.build_tree if self.plan is not None
                    else build_tree)
+        # fold both iteration and class index: multiclass trees of one
+        # iteration must sample independently (the reference's shared RNG
+        # advances per tree)
+        key = (jax.random.fold_in(
+            jax.random.fold_in(self._tree_key, self.iter_), k)
+            if self._tree_key is not None else None)
         return builder(
             self.train_dd.bins, gh, self.train_dd.row_leaf0,
             self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
@@ -304,7 +396,10 @@ class GBDT:
             split_params=self.split_params,
             hist_dtype=cfg.hist_dtype, block_rows=self.block,
             valid_bins=tuple(dd.bins for dd in self.valid_dd),
-            valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd))
+            valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd),
+            mono_type_pf=self.mono_type_pf,
+            interaction_groups=self.interaction_groups,
+            rng_key=key, feature_fraction_bynode=self._ffbn)
 
     def _bias_adjust_device(self, tree_arrays: TreeArrays, bias: float,
                             shrink: float) -> TreeArrays:
@@ -330,7 +425,7 @@ class GBDT:
         should_continue = False
         for k in range(self.K):
             gh = jnp.stack([g[k], h[k], count_mask], axis=1)
-            tree_arrays, row_leaf, valid_rls = self._build_one_tree(gh, fmask)
+            tree_arrays, row_leaf, valid_rls = self._build_one_tree(gh, fmask, k)
             host = jax.tree.map(np.asarray, tree_arrays)
             num_leaves_trained = int(host.num_leaves)
             shrink = self.shrinkage

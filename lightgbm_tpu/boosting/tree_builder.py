@@ -36,6 +36,20 @@ Design (TPU-first; not a translation):
   selection then runs replicated and identically on every shard, which
   replaces SyncUpGlobalBestSplit (parallel_tree_learner.h:209) since a
   deterministic replicated argmax needs no sync.
+
+Constraint machinery (all vectorized, no data-dependent shapes):
+- Monotone constraints (basic mode, monotone_constraints.hpp:465-516):
+  per-leaf output bounds [leaf_lo, leaf_hi]; on a numerical split of a
+  constrained feature, mid = (left_out + right_out)/2 tightens the
+  children's bounds. The split finder clamps candidate outputs and rejects
+  direction violations.
+- Interaction constraints (col_sampler.hpp:125-180 GetByNode): per-leaf
+  used-feature sets [L+1, F] bool; a feature is allowed iff some constraint
+  group contains the leaf's whole branch path — two boolean matmuls
+  against the static group matrix.
+- Per-node feature sampling (feature_fraction_bynode) and extra-trees
+  random thresholds draw from a replicated PRNG key folded with the round
+  counter, so every chip samples identically.
 """
 
 from __future__ import annotations
@@ -54,6 +68,7 @@ from ..ops.split import SplitParams, find_best_splits, leaf_output
 __all__ = ["TreeArrays", "build_tree", "max_rounds_for"]
 
 NEG_INF = -jnp.inf
+F32_MAX = 3.4e38  # monotone bounds start effectively unconstrained
 
 
 class TreeArrays(NamedTuple):
@@ -83,10 +98,15 @@ def max_rounds_for(num_leaves: int, leaf_batch: int) -> int:
     return r
 
 
+def _round_int(x):
+    return jnp.floor(x + 0.5)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
-                     "split_params", "axis_name", "hist_dtype", "block_rows"))
+                     "split_params", "axis_name", "hist_dtype", "block_rows",
+                     "feature_fraction_bynode"))
 def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
@@ -95,7 +115,11 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                axis_name: Optional[str] = None,
                hist_dtype: str = "bfloat16", block_rows: int = 0,
                valid_bins: Tuple[jax.Array, ...] = (),
-               valid_row_leaf0: Tuple[jax.Array, ...] = ()):
+               valid_row_leaf0: Tuple[jax.Array, ...] = (),
+               mono_type_pf: Optional[jax.Array] = None,
+               interaction_groups: Optional[jax.Array] = None,
+               rng_key: Optional[jax.Array] = None,
+               feature_fraction_bynode: float = 1.0):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs)."""
     R, F = bins.shape
     L = num_leaves
@@ -106,26 +130,73 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     DUMMY_NODE = MAXN
 
     f32 = jnp.float32
+    sp = split_params
+    use_mono = mono_type_pf is not None
+    use_inter = interaction_groups is not None
+    use_bynode = feature_fraction_bynode < 1.0
+    use_rand = bool(sp.extra_trees)
+    if (use_bynode or use_rand) and rng_key is None:
+        raise ValueError("feature_fraction_bynode/extra_trees need rng_key")
 
     def hist_for(slots, rl):
         return build_histograms(
             bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
             axis_name=axis_name, hist_dtype=hist_dtype)
 
-    def best_for(hist2w, slot_depth, slot_valid):
-        bs = find_best_splits(hist2w, num_bins_pf, nan_bin_pf, is_cat_pf,
-                              split_params)
+    nnb_pf = num_bins_pf - (nan_bin_pf >= 0).astype(jnp.int32)
+
+    def slot_masks_and_bins(used_feat, slots_c, key):
+        """Per-slot candidate features + extra-trees random thresholds."""
+        S = slots_c.shape[0]
+        fmask = jnp.broadcast_to(feature_mask[None, :], (S, F))
+        if use_inter:
+            used = jnp.take(used_feat, slots_c, axis=0)          # [S, F]
+            # group ok iff no used feature outside it: used @ ~group == 0
+            viol = used.astype(f32) @ (~interaction_groups).astype(f32).T
+            allowed = ((viol == 0).astype(f32)
+                       @ interaction_groups.astype(f32)) > 0     # [S, F]
+            fmask = fmask & allowed
+        if use_bynode:
+            # GetCnt over the tree-sampled set, capped by the allowed set
+            # (col_sampler.hpp:190-205)
+            n_tree = feature_mask.sum().astype(f32)
+            n_allow = fmask.sum(axis=1).astype(f32)              # [S]
+            k = _round_int(n_tree * feature_fraction_bynode)
+            k = jnp.minimum(jnp.maximum(k, 1.0), n_allow)
+            k = jnp.maximum(k, jnp.minimum(1.0, n_allow)).astype(jnp.int32)
+            u = jax.random.uniform(jax.random.fold_in(key, 1), (S, F))
+            score = jnp.where(fmask, u, -1.0)
+            kth = jnp.take_along_axis(
+                -jnp.sort(-score, axis=1),
+                jnp.maximum(k - 1, 0)[:, None], axis=1)
+            fmask = fmask & (score >= kth)
+        rand_bin = None
+        if use_rand:
+            u2 = jax.random.uniform(jax.random.fold_in(key, 2), (S, F))
+            n_num = jnp.maximum(nnb_pf - 1, 1).astype(f32)       # thresholds
+            n_cat = jnp.maximum(nnb_pf, 1).astype(f32)
+            n_opt = jnp.where(is_cat_pf, n_cat, n_num)[None, :]
+            rand_bin = jnp.floor(u2 * n_opt).astype(jnp.int32)
+        return fmask, rand_bin
+
+    def best_for(hist2w, slot_depth, slot_valid, slots_c, t, state, key):
+        lo = jnp.take(state["leaf_lo"], slots_c) if use_mono else None
+        hi = jnp.take(state["leaf_hi"], slots_c) if use_mono else None
+        node_of = jnp.take(t.leaf2node, slots_c)
+        parent_out = jnp.take(t.node_value, node_of)
+        fmask_s, rand_bin = slot_masks_and_bins(
+            state.get("used_feat"), slots_c, key)
+        bs = find_best_splits(
+            hist2w, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
+            feature_mask=fmask_s, mono_type=mono_type_pf,
+            leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
+            slot_depth=slot_depth, rand_bin=rand_bin)
         g = bs["gain"]
-        # feature sampling / interaction masks
-        fmask_ok = jnp.take(feature_mask, bs["feature"])
-        g = jnp.where(fmask_ok, g, NEG_INF)
         if max_depth > 0:
             g = jnp.where(slot_depth < max_depth, g, NEG_INF)
         g = jnp.where(slot_valid, g, NEG_INF)
         bs["gain"] = g
         return bs
-
-    sp = split_params
 
     # ---------------- state ----------------
     tree = TreeArrays(
@@ -154,24 +225,34 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     bs_cat = jnp.zeros((L + 1,), bool)
     bs_left = jnp.zeros((L + 1, HIST_CH), f32)
     bs_right = jnp.zeros((L + 1, HIST_CH), f32)
+    bs_lout = jnp.zeros((L + 1,), f32)
+    bs_rout = jnp.zeros((L + 1,), f32)
     leaf_depth = jnp.zeros((L + 1,), jnp.int32)
+
+    state = dict(row_leaf=row_leaf0,
+                 valid_row_leaf=tuple(valid_row_leaf0),
+                 leaf_lo=jnp.full((L + 1,), -F32_MAX, f32),
+                 leaf_hi=jnp.full((L + 1,), F32_MAX, f32),
+                 r=jnp.asarray(0, jnp.int32))
+    if use_inter:
+        state["used_feat"] = jnp.zeros((L + 1, F), bool)
 
     # ---------------- root ----------------
     root_slots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(0)
     hist0 = hist_for(root_slots, row_leaf0)
     root_sums = hist0[0, 0, :, :].sum(axis=0)       # all rows land in f0 bins
-    slot_valid0 = jnp.zeros((2 * W,), bool).at[0].set(True)
-    bs0 = best_for(hist0, jnp.zeros((2 * W,), jnp.int32), slot_valid0)
+    root_val = leaf_output(root_sums[0], root_sums[1], sp.lambda_l1,
+                           sp.lambda_l2, sp.max_delta_step)
     tree = tree._replace(
-        node_value=tree.node_value.at[0].set(
-            leaf_output(root_sums[0], root_sums[1], sp.lambda_l1,
-                        sp.lambda_l2, sp.max_delta_step)),
+        node_value=tree.node_value.at[0].set(root_val),
         node_count=tree.node_count.at[0].set(root_sums[2]),
         node_hess=tree.node_hess.at[0].set(root_sums[1]),
-        leaf_values=tree.leaf_values.at[0].set(
-            leaf_output(root_sums[0], root_sums[1], sp.lambda_l1,
-                        sp.lambda_l2, sp.max_delta_step)),
+        leaf_values=tree.leaf_values.at[0].set(root_val),
     )
+    slot_valid0 = jnp.zeros((2 * W,), bool).at[0].set(True)
+    key0 = (jax.random.fold_in(rng_key, 0) if rng_key is not None else None)
+    bs0 = best_for(hist0, jnp.zeros((2 * W,), jnp.int32), slot_valid0,
+                   root_slots.clip(0), tree, state, key0)
     bs_gain = bs_gain.at[0].set(bs0["gain"][0])
     bs_feat = bs_feat.at[0].set(bs0["feature"][0])
     bs_thr = bs_thr.at[0].set(bs0["threshold"][0])
@@ -179,15 +260,15 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     bs_cat = bs_cat.at[0].set(bs0["is_cat_split"][0])
     bs_left = bs_left.at[0].set(bs0["left_sum"][0])
     bs_right = bs_right.at[0].set(bs0["right_sum"][0])
+    bs_lout = bs_lout.at[0].set(bs0["left_out"][0])
+    bs_rout = bs_rout.at[0].set(bs0["right_out"][0])
 
     rounds_bound = max_rounds_for(L, W)
 
-    state = dict(tree=tree, row_leaf=row_leaf0,
-                 valid_row_leaf=tuple(valid_row_leaf0),
-                 bs_gain=bs_gain, bs_feat=bs_feat, bs_thr=bs_thr,
+    state.update(tree=tree, bs_gain=bs_gain, bs_feat=bs_feat, bs_thr=bs_thr,
                  bs_dl=bs_dl, bs_cat=bs_cat, bs_left=bs_left,
-                 bs_right=bs_right, leaf_depth=leaf_depth,
-                 r=jnp.asarray(0, jnp.int32))
+                 bs_right=bs_right, bs_lout=bs_lout, bs_rout=bs_rout,
+                 leaf_depth=leaf_depth)
 
     def cond(st):
         t = st["tree"]
@@ -219,10 +300,10 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         sgain = jnp.take(st["bs_gain"], sel_s)
         slsum = jnp.take(st["bs_left"], sel_s, axis=0)
         srsum = jnp.take(st["bs_right"], sel_s, axis=0)
-        lval = leaf_output(slsum[:, 0], slsum[:, 1], sp.lambda_l1,
-                           sp.lambda_l2, sp.max_delta_step)
-        rval = leaf_output(srsum[:, 0], srsum[:, 1], sp.lambda_l1,
-                           sp.lambda_l2, sp.max_delta_step)
+        # constrained/smoothed outputs computed by the split finder
+        # (SplitInfo::left_output/right_output analog)
+        lval = jnp.take(st["bs_lout"], sel_s)
+        rval = jnp.take(st["bs_rout"], sel_s)
 
         # -- 2. record splits in node arrays
         t = t._replace(
@@ -247,6 +328,37 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         new_depth = jnp.take(st["leaf_depth"], sel_s) + 1
         leaf_depth = st["leaf_depth"].at[sel_s].set(new_depth) \
                                      .at[right_slot].set(new_depth)
+
+        # -- 2b. monotone bound propagation (BasicLeafConstraints::Update,
+        # monotone_constraints.hpp:488-504): numerical splits on constrained
+        # features tighten children's bounds around the output midpoint
+        leaf_lo, leaf_hi = st["leaf_lo"], st["leaf_hi"]
+        if use_mono:
+            mid = (lval + rval) * 0.5
+            mt_s = jnp.take(mono_type_pf, sfeat)
+            upd = valid & (~scat) & (mt_s != 0)
+            lo_p = jnp.take(leaf_lo, sel_s)
+            hi_p = jnp.take(leaf_hi, sel_s)
+            hi_l = jnp.where(upd & (mt_s > 0), jnp.minimum(hi_p, mid), hi_p)
+            lo_l = jnp.where(upd & (mt_s < 0), jnp.maximum(lo_p, mid), lo_p)
+            lo_r = jnp.where(upd & (mt_s > 0), jnp.maximum(lo_p, mid), lo_p)
+            hi_r = jnp.where(upd & (mt_s < 0), jnp.minimum(hi_p, mid), hi_p)
+            leaf_lo = leaf_lo.at[sel_s].set(lo_l).at[right_slot].set(lo_r) \
+                             .at[DUMMY_LEAF].set(-F32_MAX)
+            leaf_hi = leaf_hi.at[sel_s].set(hi_l).at[right_slot].set(hi_r) \
+                             .at[DUMMY_LEAF].set(F32_MAX)
+
+        # -- 2c. branch feature tracking for interaction constraints
+        new_state_extra = {}
+        if use_inter:
+            uf = st["used_feat"]
+            parent_used = jnp.take(uf, sel_s, axis=0)            # [W, F]
+            fbit = ((jnp.arange(F)[None, :] == sfeat[:, None])
+                    & valid[:, None])
+            new_used = parent_used | fbit
+            uf = uf.at[sel_s].set(new_used).at[right_slot].set(new_used) \
+                   .at[DUMMY_LEAF].set(False)
+            new_state_extra["used_feat"] = uf
 
         # -- 3. vectorized partition update (DataPartition::Split analog)
         pend_active = jnp.zeros((L + 1,), bool).at[sel_s].set(valid) \
@@ -282,9 +394,14 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         hist2w = hist_for(slots2w, row_leaf)
         depth2w = jnp.take(leaf_depth,
                            jnp.concatenate([sel_s, right_slot]))
-        bs = best_for(hist2w, depth2w, jnp.concatenate([valid, valid]))
+        keyr = (jax.random.fold_in(rng_key, st["r"] + 1)
+                if rng_key is not None else None)
+        mid_state = dict(leaf_lo=leaf_lo, leaf_hi=leaf_hi, **new_state_extra)
+        slots2w_c = jnp.where(slots2w >= 0, slots2w, DUMMY_LEAF)
+        bs = best_for(hist2w, depth2w, jnp.concatenate([valid, valid]),
+                      slots2w_c, t, mid_state, keyr)
 
-        scatter_slots = jnp.where(slots2w >= 0, slots2w, DUMMY_LEAF)
+        scatter_slots = slots2w_c
         bs_gain = st["bs_gain"].at[scatter_slots].set(bs["gain"]) \
                                .at[DUMMY_LEAF].set(NEG_INF)
         bs_feat = st["bs_feat"].at[scatter_slots].set(bs["feature"])
@@ -293,12 +410,16 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         bs_cat = st["bs_cat"].at[scatter_slots].set(bs["is_cat_split"])
         bs_left = st["bs_left"].at[scatter_slots].set(bs["left_sum"])
         bs_right = st["bs_right"].at[scatter_slots].set(bs["right_sum"])
+        bs_lout = st["bs_lout"].at[scatter_slots].set(bs["left_out"])
+        bs_rout = st["bs_rout"].at[scatter_slots].set(bs["right_out"])
 
-        return dict(tree=t, row_leaf=row_leaf, valid_row_leaf=valid_row_leaf,
-                    bs_gain=bs_gain, bs_feat=bs_feat, bs_thr=bs_thr,
-                    bs_dl=bs_dl, bs_cat=bs_cat, bs_left=bs_left,
-                    bs_right=bs_right, leaf_depth=leaf_depth,
-                    r=st["r"] + 1)
+        out = dict(tree=t, row_leaf=row_leaf, valid_row_leaf=valid_row_leaf,
+                   bs_gain=bs_gain, bs_feat=bs_feat, bs_thr=bs_thr,
+                   bs_dl=bs_dl, bs_cat=bs_cat, bs_left=bs_left,
+                   bs_right=bs_right, bs_lout=bs_lout, bs_rout=bs_rout,
+                   leaf_depth=leaf_depth, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+                   r=st["r"] + 1, **new_state_extra)
+        return out
 
     state = jax.lax.while_loop(cond, body, state)
     return state["tree"], state["row_leaf"], state["valid_row_leaf"]

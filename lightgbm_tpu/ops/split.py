@@ -2,6 +2,7 @@
 
 TPU-native analog of the reference split finder (LightGBM
 ``src/treelearner/feature_histogram.hpp:165`` ``FindBestThreshold``,
+``feature_histogram.cpp:120-360`` categorical,
 ``cuda/cuda_best_split_finder.cu``): for each (leaf, feature) scan bin
 thresholds in both missing-direction variants and keep the max-gain split.
 
@@ -10,30 +11,49 @@ missing-right) in scalar loops. Here the whole search is one vectorized
 cumsum + gain evaluation over a dense [leaves, features, bins, 2] lattice —
 an argmax XLA reduces on-device; no data-dependent control flow.
 
-Gain math mirrors feature_histogram.hpp exactly:
-  ThresholdL1(s, l1) = sign(s) * max(|s| - l1, 0)
-  leaf_gain(G, H)    = ThresholdL1(G)^2 / (H + l2)
-  split_gain         = leaf_gain(GL) + leaf_gain(GR)  (parent part constant)
-  leaf_output(G, H)  = -ThresholdL1(G) / (H + l2)
-Validity: counts >= min_data_in_leaf, hessians >= min_sum_hessian_in_leaf on
-both sides; gain must exceed leaf_gain(parent) + min_gain_to_split
-(the reference's gain_shift).
+Gain math mirrors feature_histogram.hpp exactly (output-based form, so
+constraints compose):
+  ThresholdL1(s, l1)  = sign(s) * max(|s| - l1, 0)
+  output(G, H)        = -ThresholdL1(G) / (H + l2), clipped by
+                        max_delta_step, smoothed toward the parent output
+                        when path_smooth > 0 (CalculateSplittedLeafOutput,
+                        feature_histogram.hpp:717-756), clamped into the
+                        leaf's monotone [lo, hi] range (BasicConstraint)
+  gain_given_output   = -(2*ThresholdL1(G)*w + (H + l2)*w^2)
+                        (GetLeafGainGivenOutput, feature_histogram.hpp:820)
+  split_gain          = gain(left) + gain(right); 0 if the two outputs
+                        violate the split feature's monotone direction
+                        (GetSplitGains, feature_histogram.hpp:760-798)
+  net gain            = split_gain - parent_gain - min_gain_to_split,
+                        multiplied by the monotone depth penalty when the
+                        split feature is constrained
+                        (ComputeMonotoneSplitGainPenalty,
+                        monotone_constraints.hpp:357-366)
+Validity: counts >= min_data_in_leaf, hessians >= min_sum_hessian_in_leaf
+on both sides; net gain must be positive (the reference's
+``current_gain <= min_gain_shift`` rejection).
 
-Categorical features use the one-hot split path (bin == t goes left) with
-cat_l2 regularization — feature_histogram.hpp FindBestThresholdCategorical's
-one-hot branch; sorted-subset categorical splits are a planned follow-up.
+Categorical features with few bins use the one-hot path (bin == t goes
+left) with plain lambda_l2 — feature_histogram.cpp:172-238 applies cat_l2
+only on the sorted-subset branch (see ops/cat_split.py).
+
+Extra-trees mode evaluates one random threshold per (leaf, feature)
+(``rand_threshold``, feature_histogram.hpp:202-205); per-node feature
+sampling and interaction constraints arrive pre-baked in ``feature_mask``.
 """
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SplitParams", "find_best_splits", "leaf_output", "leaf_gain"]
+__all__ = ["SplitParams", "find_best_splits", "leaf_output", "leaf_gain",
+           "gain_given_output", "calc_output", "monotone_penalty_factor"]
 
 NEG_INF = -jnp.inf
+K_EPS = 1e-15
 
 
 class SplitParams(NamedTuple):
@@ -45,6 +65,12 @@ class SplitParams(NamedTuple):
     cat_l2: float = 10.0
     cat_smooth: float = 10.0
     max_delta_step: float = 0.0
+    path_smooth: float = 0.0
+    monotone_penalty: float = 0.0
+    extra_trees: bool = False
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
 
 
 def _threshold_l1(s, l1):
@@ -65,9 +91,44 @@ def leaf_output(g, h, l1, l2, max_delta_step=0.0):
     return out
 
 
+def calc_output(g, h, l1, l2, max_delta_step=0.0, path_smooth=0.0,
+                count=None, parent_output=None):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:717-740):
+    raw regularized output, max_delta_step clip, then path smoothing
+    toward the parent's output weighted by leaf count."""
+    out = leaf_output(g, h, l1, l2, max_delta_step)
+    if path_smooth > 0.0:
+        sm = count / path_smooth
+        out = out * sm / (sm + 1.0) + parent_output / (sm + 1.0)
+    return out
+
+
+def gain_given_output(g, h, l1, l2, out):
+    """GetLeafGainGivenOutput (feature_histogram.hpp:820-831)."""
+    t = _threshold_l1(g, l1)
+    return -(2.0 * t * out + (h + l2) * out * out)
+
+
+def monotone_penalty_factor(depth, penalization):
+    """ComputeMonotoneSplitGainPenalty (monotone_constraints.hpp:357-366)."""
+    depth = depth.astype(jnp.float32)
+    pen_le1 = 1.0 - penalization / jnp.exp2(depth) + K_EPS
+    pen_gt1 = 1.0 - jnp.exp2(penalization - 1.0 - depth) + K_EPS
+    pen = jnp.where(penalization <= 1.0, pen_le1, pen_gt1)
+    return jnp.where(penalization >= depth + 1.0, K_EPS, pen)
+
+
 def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
                      nan_bin: jax.Array, is_cat: jax.Array,
-                     params: SplitParams) -> Dict[str, jax.Array]:
+                     params: SplitParams,
+                     feature_mask: Optional[jax.Array] = None,
+                     mono_type: Optional[jax.Array] = None,
+                     leaf_lo: Optional[jax.Array] = None,
+                     leaf_hi: Optional[jax.Array] = None,
+                     parent_output: Optional[jax.Array] = None,
+                     slot_depth: Optional[jax.Array] = None,
+                     rand_bin: Optional[jax.Array] = None
+                     ) -> Dict[str, jax.Array]:
     """Vectorized best split per leaf.
 
     Args:
@@ -76,13 +137,29 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
       nan_bin: [F] int32 — NaN bin index per feature, -1 if none.
       is_cat: [F] bool — categorical feature flags.
       params: SplitParams.
+      feature_mask: optional [F] or [L, F] bool — candidate features,
+        applied BEFORE the argmax (per-tree sampling, per-node sampling,
+        interaction constraints).
+      mono_type: optional [F] int32 in {-1, 0, 1} — monotone directions.
+      leaf_lo / leaf_hi: optional [L] f32 — per-leaf output bounds
+        (BasicConstraint of monotone_constraints.hpp).
+      parent_output: optional [L] f32 — each slot's current output
+        (unshrunk), required when path_smooth > 0.
+      slot_depth: optional [L] int32 — leaf depth, for monotone_penalty.
+      rand_bin: optional [L, F] int32 — extra-trees random threshold;
+        only this bin is evaluated per (leaf, feature).
 
     Returns dict with per-leaf arrays:
-      gain [L] (-inf when no valid split), feature [L], threshold [L],
-      default_left [L] bool, left_sum/right_sum [L, 3], is_cat_split [L].
+      gain [L] — NET gain (split - parent - min_gain_to_split, penalized;
+        -inf when no valid split), feature [L], threshold [L],
+      default_left [L] bool, left_sum/right_sum [L, 3],
+      left_out/right_out [L] (constrained outputs), is_cat_split [L].
     """
     L, F, B, _ = hist.shape
     l1, l2 = params.lambda_l1, params.lambda_l2
+    mds = params.max_delta_step
+    use_mono = mono_type is not None
+    use_smooth = params.path_smooth > 0.0
     bins_iota = jnp.arange(B, dtype=jnp.int32)
 
     has_nan = nan_bin >= 0                                     # [F]
@@ -120,26 +197,75 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     left = jnp.where(is_cat[None, :, None, None, None], cat_left, num_left)
     right = jnp.where(is_cat[None, :, None, None, None], cat_right, num_right)
     valid = jnp.where(is_cat[None, :, None, None], cat_valid, num_valid)
+    if rand_bin is not None:  # extra_trees: one threshold per (leaf, feat)
+        valid = valid & (bins_iota[None, None, :, None]
+                         == rand_bin[:, :, None, None])
 
     gL, hL, nL = left[..., 0], left[..., 1], left[..., 2]
     gR, hR, nR = right[..., 0], right[..., 1], right[..., 2]
 
-    l2_eff = jnp.where(is_cat, l2 + params.cat_l2, l2)[None, :, None, None]
-    gain = (_threshold_l1(gL, l1) ** 2 / (hL + l2_eff)
-            + _threshold_l1(gR, l1) ** 2 / (hR + l2_eff))
+    # one-hot categorical uses plain l2 (feature_histogram.cpp:178 — cat_l2
+    # applies only to sorted-subset splits)
+    sm_kw_l = {}
+    sm_kw_r = {}
+    if use_smooth:
+        po = parent_output[:, None, None, None]
+        sm_kw_l = dict(path_smooth=params.path_smooth, count=nL,
+                       parent_output=po)
+        sm_kw_r = dict(path_smooth=params.path_smooth, count=nR,
+                       parent_output=po)
+    out_l = calc_output(gL, hL, l1, l2, mds, **sm_kw_l)
+    out_r = calc_output(gR, hR, l1, l2, mds, **sm_kw_r)
+    if use_mono:
+        lo = leaf_lo[:, None, None, None]
+        hi = leaf_hi[:, None, None, None]
+        out_l = jnp.clip(out_l, lo, hi)
+        out_r = jnp.clip(out_r, lo, hi)
+
+    gain = (gain_given_output(gL, hL, l1, l2, out_l)
+            + gain_given_output(gR, hR, l1, l2, out_r))
+    if use_mono:
+        mt = mono_type[None, :, None, None]
+        viol = (((mt > 0) & (out_l > out_r)) | ((mt < 0) & (out_l < out_r)))
+        gain = jnp.where(viol, 0.0, gain)  # GetSplitGains returns 0
 
     md, mh = params.min_data_in_leaf, params.min_sum_hessian_in_leaf
     ok = (valid & (nL >= md) & (nR >= md) & (hL >= mh) & (hR >= mh))
-    gain = jnp.where(ok, gain, NEG_INF)
 
-    # parent gain + min_gain_to_split: the reference's gain_shift
-    pg = leaf_gain(totals[..., 0], totals[..., 1], l1, l2)      # [L, F]
-    gain_shift = pg[:, :, None, None] + params.min_gain_to_split
-    real_gain = gain - gain_shift
-    gain = jnp.where(real_gain > 1e-10, gain, NEG_INF)
+    # parent gain (gain_shift, BeforeNumerical feature_histogram.hpp:198):
+    # plain l2 for every feature (the categorical comment at
+    # feature_histogram.cpp:164-166 — min_split_gain uses the original l2)
+    g_tot, h_tot, n_tot = totals[..., 0], totals[..., 1], totals[..., 2]
+    if use_smooth:
+        # numerical: output smoothed toward the slot's own current output;
+        # categorical: gain at the current output directly
+        # (feature_histogram.cpp:160-166)
+        p_out_num = calc_output(g_tot, h_tot, l1, l2, mds,
+                                params.path_smooth, n_tot,
+                                parent_output[:, None])
+        p_out = jnp.where(is_cat[None, :], parent_output[:, None], p_out_num)
+        pg = gain_given_output(g_tot, h_tot, l1, l2, p_out)
+    elif mds > 0.0:
+        p_out = calc_output(g_tot, h_tot, l1, l2, mds)
+        pg = gain_given_output(g_tot, h_tot, l1, l2, p_out)
+    else:
+        pg = leaf_gain(g_tot, h_tot, l1, l2)                    # [L, F]
+
+    net = gain - pg[:, :, None, None] - params.min_gain_to_split
+    net = jnp.where(ok & (net > 1e-10), net, NEG_INF)
+
+    if use_mono and params.monotone_penalty > 0.0:
+        pen = monotone_penalty_factor(slot_depth, params.monotone_penalty)
+        mt = mono_type[None, :, None, None]
+        net = jnp.where(mt != 0, net * pen[:, None, None, None], net)
+
+    if feature_mask is not None:
+        fm = (feature_mask[None, :] if feature_mask.ndim == 1
+              else feature_mask)                                # [L, F]
+        net = jnp.where(fm[:, :, None, None], net, NEG_INF)
 
     # ---- argmax over (F, B, 2) per leaf
-    flat = gain.reshape(L, F * B * 2)
+    flat = net.reshape(L, F * B * 2)
     best = jnp.argmax(flat, axis=1)
     best_gain = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
     feat = (best // (B * 2)).astype(jnp.int32)
@@ -147,23 +273,23 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     opt = (best % 2).astype(jnp.int32)
     default_left = opt == 1
 
-    def take(a):
-        # a: [L, F, B, 2, ...] -> per-leaf best entry
+    def take3(a):
         af = a.reshape(L, F * B * 2, 3)
         return jnp.take_along_axis(af, best[:, None, None], axis=1)[:, 0, :]
 
-    left_sum = take(left)
-    right_sum = take(right)
-    pgain_best = jnp.take_along_axis(pg, feat[:, None], axis=1)[:, 0]
+    def take1(a):
+        af = a.reshape(L, F * B * 2)
+        return jnp.take_along_axis(af, best[:, None], axis=1)[:, 0]
 
     return {
-        "gain": jnp.where(jnp.isfinite(best_gain),
-                          best_gain - pgain_best, NEG_INF),
+        "gain": best_gain,
         "feature": feat,
         "threshold": thr,
         "default_left": default_left,
-        "left_sum": left_sum,
-        "right_sum": right_sum,
+        "left_sum": take3(left),
+        "right_sum": take3(right),
+        "left_out": take1(out_l),
+        "right_out": take1(out_r),
         "is_cat_split": jnp.take_along_axis(
             is_cat[None, :].repeat(L, 0), feat[:, None], axis=1)[:, 0],
     }

@@ -94,52 +94,64 @@ class DataParallelPlan:
                    split_params: SplitParams, hist_dtype: str = "bfloat16",
                    block_rows: int = 0,
                    valid_bins: Tuple[jax.Array, ...] = (),
-                   valid_row_leaf0: Tuple[jax.Array, ...] = ()):
+                   valid_row_leaf0: Tuple[jax.Array, ...] = (),
+                   mono_type_pf=None, interaction_groups=None,
+                   rng_key=None, feature_fraction_bynode: float = 1.0):
         return build_tree_dp(
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask, num_leaves=num_leaves,
             leaf_batch=leaf_batch, max_depth=max_depth, num_bins=num_bins,
             split_params=split_params, axis_name=self.axis_name,
             hist_dtype=hist_dtype, block_rows=block_rows,
-            valid_bins=valid_bins, valid_row_leaf0=valid_row_leaf0)
+            valid_bins=valid_bins, valid_row_leaf0=valid_row_leaf0,
+            mono_type_pf=mono_type_pf,
+            interaction_groups=interaction_groups, rng_key=rng_key,
+            feature_fraction_bynode=feature_fraction_bynode)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype",
-                     "block_rows", "n_valid"))
+                     "block_rows", "n_valid", "feature_fraction_bynode"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
-                       is_cat_pf, feature_mask, valid_flat, *,
+                       is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, block_rows,
-                       n_valid):
+                       n_valid, feature_fraction_bynode):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
 
-    def step(b, g, rl, nbpf, nanpf, catpf, fmask, vflat):
+    def step(b, g, rl, nbpf, nanpf, catpf, fmask, vflat, extra):
         vbins = tuple(vflat[:n_valid])
         vrl = tuple(vflat[n_valid:])
+        mono, groups, key = extra
         return build_tree(
             b, g, rl, nbpf, nanpf, catpf, fmask,
             num_leaves=num_leaves, leaf_batch=leaf_batch,
             max_depth=max_depth, num_bins=num_bins,
             split_params=split_params, axis_name=axis_name,
             hist_dtype=hist_dtype, block_rows=block_rows,
-            valid_bins=vbins, valid_row_leaf0=vrl)
+            valid_bins=vbins, valid_row_leaf0=vrl,
+            mono_type_pf=mono, interaction_groups=groups, rng_key=key,
+            feature_fraction_bynode=feature_fraction_bynode)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
     valid_in_specs = tuple([row2] * n_valid + [row] * n_valid)
     out_valid_specs = tuple([row] * n_valid)
+    # constraint metadata and PRNG key are replicated: every chip samples
+    # and constrains identically, keeping the replicated argmax in sync
+    extras_specs = jax.tree.map(lambda _: rep, extras)
 
     fn = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(row2, row2, row, rep, rep, rep, rep, valid_in_specs),
+        in_specs=(row2, row2, row, rep, rep, rep, rep, valid_in_specs,
+                  extras_specs),
         out_specs=(tree_specs, row, out_valid_specs))
     return fn(bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
-              feature_mask, valid_flat)
+              feature_mask, valid_flat, extras)
 
 
 def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
@@ -148,7 +160,9 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   split_params: SplitParams, axis_name: str = AXIS,
                   hist_dtype: str = "bfloat16", block_rows: int = 0,
                   valid_bins: Tuple[jax.Array, ...] = (),
-                  valid_row_leaf0: Tuple[jax.Array, ...] = ()):
+                  valid_row_leaf0: Tuple[jax.Array, ...] = (),
+                  mono_type_pf=None, interaction_groups=None, rng_key=None,
+                  feature_fraction_bynode: float = 1.0):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
@@ -156,10 +170,12 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     returned row→leaf assignments stay row-sharded.
     """
     valid_flat = tuple(valid_bins) + tuple(valid_row_leaf0)
+    extras = (mono_type_pf, interaction_groups, rng_key)
     return _build_tree_dp_jit(
         mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
-        feature_mask, valid_flat, num_leaves=num_leaves,
+        feature_mask, valid_flat, extras, num_leaves=num_leaves,
         leaf_batch=leaf_batch, max_depth=max_depth, num_bins=num_bins,
         split_params=split_params, axis_name=axis_name,
         hist_dtype=hist_dtype, block_rows=block_rows,
-        n_valid=len(valid_bins))
+        n_valid=len(valid_bins),
+        feature_fraction_bynode=feature_fraction_bynode)

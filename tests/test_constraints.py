@@ -1,0 +1,166 @@
+"""Monotone / interaction constraints, per-node sampling, extra-trees,
+path smoothing (reference test_engine.py constraint coverage model:
+test_monotone_constraints, test_interaction_constraints,
+test_extra_trees, test_path_smooth)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _mono_data(rng, n=2500):
+    X = rng.uniform(-1, 1, size=(n, 3))
+    # y increasing in x0, decreasing in x1, noisy in x2
+    y = (5 * X[:, 0] + np.sin(6 * X[:, 0])
+         - 5 * X[:, 1] - np.cos(4 * X[:, 1])
+         + rng.normal(scale=0.2, size=n))
+    return X, y
+
+
+def _is_monotone(bst, X, feat, increasing, grid=40):
+    base = X[:200].copy()
+    vals = np.linspace(-1, 1, grid)
+    preds = []
+    for v in vals:
+        Xi = base.copy()
+        Xi[:, feat] = v
+        preds.append(bst.predict(Xi))
+    preds = np.stack(preds, axis=0)  # [grid, rows]
+    diffs = np.diff(preds, axis=0)
+    return np.all(diffs >= -1e-10) if increasing else np.all(diffs <= 1e-10)
+
+
+def test_monotone_constraints_enforced(rng):
+    X, y = _mono_data(rng)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 5}
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(params, ds, 40)
+    assert _is_monotone(bst, X, 0, increasing=True)
+    assert _is_monotone(bst, X, 1, increasing=False)
+    # unconstrained model on the same data violates monotonicity somewhere
+    free = lgb.train({**params, "monotone_constraints": [0, 0, 0]},
+                     lgb.Dataset(X, label=y), 40)
+    assert not (_is_monotone(free, X, 0, True)
+                and _is_monotone(free, X, 1, False))
+    # constrained model still learns the signal
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.8
+
+
+def test_monotone_penalty_trains(rng):
+    X, y = _mono_data(rng)
+    params = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0], "monotone_penalty": 2.0}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 20)
+    assert _is_monotone(bst, X, 0, increasing=True)
+    # penalty forbids monotone splits at depths < penalty: the roots of all
+    # trees must split on the unconstrained feature 2
+    for t in bst._all_trees():
+        if t.num_leaves > 1:
+            assert t.split_feature[0] == 2
+
+
+def test_monotone_constraints_validation(rng):
+    X, y = _mono_data(rng)
+    with pytest.raises(ValueError, match="entries"):
+        lgb.train({"objective": "regression",
+                   "monotone_constraints": [1, -1], "verbosity": -1},
+                  lgb.Dataset(X, label=y), 2)
+    with pytest.raises(NotImplementedError, match="intermediate"):
+        lgb.train({"objective": "regression",
+                   "monotone_constraints": [1, -1, 0],
+                   "monotone_constraints_method": "intermediate",
+                   "verbosity": -1},
+                  lgb.Dataset(X, label=y), 2)
+
+
+def test_interaction_constraints_respected(rng):
+    n = 2000
+    X = rng.normal(size=(n, 4))
+    y = X[:, 0] * X[:, 1] + X[:, 2] * X[:, 3] + 0.1 * rng.normal(size=n)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "interaction_constraints": [[0, 1], [2, 3]],
+              "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 25)
+
+    # every branch path must stay inside one group
+    def check_branch(tree, node, used):
+        if node < 0:
+            return
+        f = tree.split_feature[node] if node < len(tree.split_feature) else -1
+        # leaf indices are encoded as ~leaf in to_text; walk structure arrays
+        used = used | {f}
+        assert used <= {0, 1} or used <= {2, 3}, used
+        l, r = tree.left_child[node], tree.right_child[node]
+        if l >= 0:
+            check_branch(tree, l, used)
+        if r >= 0:
+            check_branch(tree, r, used)
+
+    for t in bst._all_trees():
+        if t.num_leaves > 1:
+            check_branch(t, 0, set())
+    # model still learns
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.5
+
+
+def test_feature_fraction_bynode(rng):
+    n = 1500
+    X = rng.normal(size=(n, 10))
+    y = X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.normal(size=n)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "feature_fraction_bynode": 0.3, "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 15)
+    # with only 3 of 10 features per node, splits must spread beyond the
+    # two informative features (the sampler forces exploration)
+    used = set()
+    for t in bst._all_trees():
+        used.update(f for f in t.split_feature[:max(0, t.num_leaves - 1)])
+    assert len(used) > 2
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.6
+    # determinism: same seed, same model
+    bst2 = lgb.train(params, lgb.Dataset(X, label=y), 15)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X))
+
+
+def test_extra_trees(rng):
+    n = 1500
+    X = rng.normal(size=(n, 6))
+    y = X[:, 0] ** 2 + X[:, 1] + 0.1 * rng.normal(size=n)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "extra_trees": True, "min_data_in_leaf": 5}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 30)
+    normal = lgb.train({**params, "extra_trees": False},
+                       lgb.Dataset(X, label=y), 30)
+    # random thresholds differ from exhaustive-search thresholds
+    et_thr = [tuple(t.threshold[:t.num_leaves - 1])
+              for t in bst._all_trees() if t.num_leaves > 1]
+    no_thr = [tuple(t.threshold[:t.num_leaves - 1])
+              for t in normal._all_trees() if t.num_leaves > 1]
+    assert et_thr != no_thr
+    # extra-trees still learns (it is a regularizer, not a lobotomy)
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.7
+
+
+def test_path_smooth(rng):
+    n = 1200
+    X = rng.normal(size=(n, 5))
+    y = X[:, 0] + 0.3 * rng.normal(size=n)
+    base = {"objective": "regression", "num_leaves": 63, "verbosity": -1,
+            "min_data_in_leaf": 2}
+    plain = lgb.train(base, lgb.Dataset(X, label=y), 10)
+    smooth = lgb.train({**base, "path_smooth": 100.0},
+                       lgb.Dataset(X, label=y), 10)
+    # smoothing pulls leaf outputs toward parents: predictions differ and
+    # per-tree leaf values have smaller spread
+    assert not np.allclose(plain.predict(X), smooth.predict(X))
+    sp_plain = np.std(plain._all_trees()[3].leaf_value)
+    sp_smooth = np.std(smooth._all_trees()[3].leaf_value)
+    assert sp_smooth < sp_plain
+    r2 = 1 - np.mean((smooth.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.7

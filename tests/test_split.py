@@ -41,10 +41,11 @@ def brute_force_best(hist, num_bins, nan_bin, params):
                         R[1] < params.min_sum_hessian_in_leaf:
                     continue
                 gain = lg(L[0], L[1]) + lg(R[0], R[1])
-                if gain - pgain <= params.min_gain_to_split + 1e-10:
+                net = gain - pgain - params.min_gain_to_split
+                if net <= 1e-10:
                     continue
-                if gain - pgain > best[0]:
-                    best = (gain - pgain, f, t, dl)
+                if net > best[0]:
+                    best = (net, f, t, dl)
     return best
 
 
@@ -125,14 +126,16 @@ def test_categorical_onehot(rng):
                          cat_l2=2.0)
     got = _run(hist.astype(np.float32), num_bins, nan_bin, is_cat, params)
     if got["is_cat_split"]:
-        # verify gain formula for the chosen one-hot split
+        # verify gain formula for the chosen one-hot split — plain l2:
+        # cat_l2 applies only to sorted-subset splits
+        # (feature_histogram.cpp:178,248)
         f, t = got["feature"], got["threshold"]
         L = hist[f, t]
         tot = hist[f].sum(axis=0)
         R = tot - L
-        l2c = params.lambda_l2 + params.cat_l2
-        gain = L[0] ** 2 / (L[1] + l2c) + R[0] ** 2 / (R[1] + l2c) \
-            - tot[0] ** 2 / (tot[1] + params.lambda_l2)
+        l2 = params.lambda_l2
+        gain = L[0] ** 2 / (L[1] + l2) + R[0] ** 2 / (R[1] + l2) \
+            - tot[0] ** 2 / (tot[1] + l2)
         np.testing.assert_allclose(got["gain"], gain, rtol=1e-4)
 
 
