@@ -36,43 +36,51 @@ def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 7):
     return X, y
 
 
-def init_backend(retries: int = 3, backoff_s: float = 5.0) -> str:
-    """Defensively initialize the JAX backend.
+def _probe_platform(timeout_s: float) -> str:
+    """Probe the accelerator in a SUBPROCESS with a hard wall-clock bound.
+
+    The axon TPU tunnel can take tens of minutes to fail its init
+    (observed: ~25 min per `jax.devices()` attempt when the chip is
+    unavailable) — probing in-process would eat the whole bench budget.
+    """
+    import subprocess
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print('PLATFORM=' + jax.devices()[0].platform)"],
+            capture_output=True, timeout=timeout_s, text=True)
+    except subprocess.TimeoutExpired:
+        return ""
+    for line in out.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1]
+    return ""
+
+
+def init_backend(retries: int = 2, probe_timeout_s: float = 300.0) -> str:
+    """Defensively choose the JAX backend BEFORE importing jax here.
 
     Round-1 failure mode (BENCH_r01.json rc=1): `jax.devices()` raised
-    `Unable to initialize backend 'axon'` mid-training. Probe the backend
-    up front with bounded retries; if the accelerator never comes up, fall
-    back to CPU so the bench still produces a (clearly-labelled) number
-    instead of a traceback.
+    `Unable to initialize backend 'axon'` mid-training. Bounded subprocess
+    probes decide the platform; if the accelerator never comes up, pin CPU
+    so the bench still produces a (clearly-labelled) number instead of a
+    traceback.
     """
-    import jax
-
-    last_err = None
+    platform = ""
     for attempt in range(retries):
-        try:
-            devs = jax.devices()
-            return devs[0].platform
-        except RuntimeError as e:  # backend init failure
-            last_err = e
-            print(f"backend init attempt {attempt + 1}/{retries} failed: {e}",
-                  file=sys.stderr)
-            if attempt == retries - 1:
-                break
-            time.sleep(backoff_s * (attempt + 1))
-            # jax caches the backend probe result; drop it so the retry
-            # actually re-probes the accelerator instead of returning the
-            # cached (possibly CPU-only) dict
-            try:
-                jax.extend.backend.clear_backends()
-            except Exception:
-                break  # can't re-probe — go straight to fallback
-    # Fall back to CPU: a real number on the wrong platform beats rc=1.
-    print(f"accelerator unavailable after {retries} attempts "
-          f"({last_err}); falling back to CPU", file=sys.stderr)
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    jax.config.update("jax_platforms", "cpu")
+        platform = _probe_platform(probe_timeout_s)
+        if platform:
+            break
+        print(f"backend probe {attempt + 1}/{retries} failed or timed out",
+              file=sys.stderr)
+    if not platform or platform == "cpu":
+        print("accelerator unavailable; pinning CPU", file=sys.stderr)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    if not platform or platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     try:
-        return jax.devices("cpu")[0].platform
+        return jax.devices()[0].platform
     except RuntimeError as e:
         print(json.dumps({
             "metric": "higgs_binary_train_throughput",

@@ -84,6 +84,7 @@ class TreeArrays(NamedTuple):
     node_value: jax.Array      # [N] f32 leaf output (unshrunk)
     node_count: jax.Array      # [N] f32
     node_hess: jax.Array       # [N] f32
+    cat_bitset: jax.Array      # [N, ceil(B/32)] uint32 LEFT subset (cat)
     leaf2node: jax.Array       # [L+1] int32
     leaf_values: jax.Array     # [L+1] f32 output per leaf slot (unshrunk)
     num_leaves: jax.Array      # scalar int32
@@ -119,7 +120,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                mono_type_pf: Optional[jax.Array] = None,
                interaction_groups: Optional[jax.Array] = None,
                rng_key: Optional[jax.Array] = None,
-               feature_fraction_bynode: float = 1.0):
+               feature_fraction_bynode: float = 1.0,
+               cat_sorted_mask: Optional[jax.Array] = None):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs)."""
     R, F = bins.shape
     L = num_leaves
@@ -128,6 +130,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     B = num_bins
     DUMMY_LEAF = L          # scatter sink for masked lanes
     DUMMY_NODE = MAXN
+    BW = (B + 31) // 32     # cat bitset words
 
     f32 = jnp.float32
     sp = split_params
@@ -190,7 +193,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             hist2w, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
             feature_mask=fmask_s, mono_type=mono_type_pf,
             leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
-            slot_depth=slot_depth, rand_bin=rand_bin)
+            slot_depth=slot_depth, rand_bin=rand_bin,
+            cat_sorted_mask=cat_sorted_mask)
         g = bs["gain"]
         if max_depth > 0:
             g = jnp.where(slot_depth < max_depth, g, NEG_INF)
@@ -210,6 +214,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         node_value=jnp.zeros((MAXN + 1,), f32),
         node_count=jnp.zeros((MAXN + 1,), f32),
         node_hess=jnp.zeros((MAXN + 1,), f32),
+        cat_bitset=jnp.zeros((MAXN + 1, BW), jnp.uint32),
         leaf2node=jnp.full((L + 1,), DUMMY_NODE, jnp.int32),
         leaf_values=jnp.zeros((L + 1,), f32),
         num_leaves=jnp.asarray(1, jnp.int32),
@@ -225,6 +230,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     bs_cat = jnp.zeros((L + 1,), bool)
     bs_left = jnp.zeros((L + 1, HIST_CH), f32)
     bs_right = jnp.zeros((L + 1, HIST_CH), f32)
+    bs_bits = jnp.zeros((L + 1, BW), jnp.uint32)
     bs_lout = jnp.zeros((L + 1,), f32)
     bs_rout = jnp.zeros((L + 1,), f32)
     leaf_depth = jnp.zeros((L + 1,), jnp.int32)
@@ -260,6 +266,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     bs_cat = bs_cat.at[0].set(bs0["is_cat_split"][0])
     bs_left = bs_left.at[0].set(bs0["left_sum"][0])
     bs_right = bs_right.at[0].set(bs0["right_sum"][0])
+    bs_bits = bs_bits.at[0].set(bs0["cat_bitset"][0])
     bs_lout = bs_lout.at[0].set(bs0["left_out"][0])
     bs_rout = bs_rout.at[0].set(bs0["right_out"][0])
 
@@ -267,8 +274,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
 
     state.update(tree=tree, bs_gain=bs_gain, bs_feat=bs_feat, bs_thr=bs_thr,
                  bs_dl=bs_dl, bs_cat=bs_cat, bs_left=bs_left,
-                 bs_right=bs_right, bs_lout=bs_lout, bs_rout=bs_rout,
-                 leaf_depth=leaf_depth)
+                 bs_right=bs_right, bs_bits=bs_bits, bs_lout=bs_lout,
+                 bs_rout=bs_rout, leaf_depth=leaf_depth)
 
     def cond(st):
         t = st["tree"]
@@ -300,6 +307,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         sgain = jnp.take(st["bs_gain"], sel_s)
         slsum = jnp.take(st["bs_left"], sel_s, axis=0)
         srsum = jnp.take(st["bs_right"], sel_s, axis=0)
+        sbits = jnp.take(st["bs_bits"], sel_s, axis=0)
         # constrained/smoothed outputs computed by the split finder
         # (SplitInfo::left_output/right_output analog)
         lval = jnp.take(st["bs_lout"], sel_s)
@@ -319,6 +327,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                                      .at[rn].set(srsum[:, 2]),
             node_hess=t.node_hess.at[ln].set(slsum[:, 1])
                                     .at[rn].set(srsum[:, 1]),
+            cat_bitset=t.cat_bitset.at[parent].set(sbits),
             leaf2node=t.leaf2node.at[sel_s].set(ln).at[right_slot].set(rn),
             leaf_values=t.leaf_values.at[sel_s].set(lval)
                                      .at[right_slot].set(rval),
@@ -368,6 +377,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         pend_dl = jnp.zeros((L + 1,), bool).at[sel_s].set(sdl)
         pend_cat = jnp.zeros((L + 1,), bool).at[sel_s].set(scat)
         pend_right = jnp.zeros((L + 1,), jnp.int32).at[sel_s].set(right_slot)
+        pend_bits = jnp.zeros((L + 1, BW), jnp.uint32).at[sel_s].set(sbits)
 
         def relabel(bmat, rl):
             rlc = jnp.where(rl < 0, DUMMY_LEAF, rl)
@@ -378,8 +388,16 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             nb = jnp.take(nan_bin_pf, feat)
             isnan = (binv == nb) & (nb >= 0)
             cat_row = jnp.take(pend_cat, rlc)
-            go_left = jnp.where(cat_row, binv == thr, binv <= thr)
-            go_left = jnp.where(isnan, jnp.take(pend_dl, rlc), go_left)
+            # categorical: bitset membership (CategoricalDecision, tree.h)
+            word = binv >> 5
+            rbits = jnp.take(pend_bits, rlc, axis=0)             # [R, BW]
+            wsel = jnp.arange(BW, dtype=jnp.int32)[None, :] == word[:, None]
+            wval = jnp.sum(jnp.where(wsel, rbits, jnp.uint32(0)), axis=1)
+            in_set = ((wval >> (binv & 31).astype(jnp.uint32))
+                      & jnp.uint32(1)) == 1
+            go_left = jnp.where(cat_row, in_set, binv <= thr)
+            go_left = jnp.where(isnan & ~cat_row,
+                                jnp.take(pend_dl, rlc), go_left)
             return jnp.where(active & ~go_left,
                              jnp.take(pend_right, rlc), rl)
 
@@ -410,13 +428,15 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         bs_cat = st["bs_cat"].at[scatter_slots].set(bs["is_cat_split"])
         bs_left = st["bs_left"].at[scatter_slots].set(bs["left_sum"])
         bs_right = st["bs_right"].at[scatter_slots].set(bs["right_sum"])
+        bs_bits = st["bs_bits"].at[scatter_slots].set(bs["cat_bitset"])
         bs_lout = st["bs_lout"].at[scatter_slots].set(bs["left_out"])
         bs_rout = st["bs_rout"].at[scatter_slots].set(bs["right_out"])
 
         out = dict(tree=t, row_leaf=row_leaf, valid_row_leaf=valid_row_leaf,
                    bs_gain=bs_gain, bs_feat=bs_feat, bs_thr=bs_thr,
                    bs_dl=bs_dl, bs_cat=bs_cat, bs_left=bs_left,
-                   bs_right=bs_right, bs_lout=bs_lout, bs_rout=bs_rout,
+                   bs_right=bs_right, bs_bits=bs_bits, bs_lout=bs_lout,
+                   bs_rout=bs_rout,
                    leaf_depth=leaf_depth, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
                    r=st["r"] + 1, **new_state_extra)
         return out

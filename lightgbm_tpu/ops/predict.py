@@ -38,15 +38,18 @@ def row_feature_gather(bins: jax.Array, feat: jax.Array) -> jax.Array:
 def predict_bins_leaf(split_feature: jax.Array, threshold_bin: jax.Array,
                       default_left: jax.Array, is_cat: jax.Array,
                       left_child: jax.Array, right_child: jax.Array,
-                      nan_bin_pf: jax.Array, bins: jax.Array) -> jax.Array:
+                      cat_bitset: jax.Array, nan_bin_pf: jax.Array,
+                      bins: jax.Array) -> jax.Array:
     """Node index where each binned row lands (NumericalDecision /
     CategoricalDecision walk of tree.h, vectorized over rows).
 
     Tree arrays are in builder (TreeArrays) numbering: ``split_feature``
     holds -1 at leaves; children are node ids in the same arrays.
-    Returns [R] int32 node ids of leaves.
+    ``cat_bitset`` [N, BW] holds the bin-space LEFT subset of categorical
+    splits. Returns [R] int32 node ids of leaves.
     """
     R = bins.shape[0]
+    BW = cat_bitset.shape[1]
     node = jnp.zeros((R,), jnp.int32)
 
     def cond(state):
@@ -63,8 +66,16 @@ def predict_bins_leaf(split_feature: jax.Array, threshold_bin: jax.Array,
         nb = jnp.take(nan_bin_pf, featc)
         isnan = (binv == nb) & (nb >= 0)
         cat = jnp.take(is_cat, node)
-        go_left = jnp.where(cat, binv == thr, binv <= thr)
-        go_left = jnp.where(isnan, jnp.take(default_left, node), go_left)
+        # categorical membership: bitset word select + bit test
+        word = binv >> 5
+        rbits = jnp.take(cat_bitset, node, axis=0)               # [R, BW]
+        wsel = jnp.arange(BW, dtype=jnp.int32)[None, :] == word[:, None]
+        wval = jnp.sum(jnp.where(wsel, rbits, jnp.uint32(0)), axis=1)
+        in_set = ((wval >> (binv & 31).astype(jnp.uint32))
+                  & jnp.uint32(1)) == 1
+        go_left = jnp.where(cat, in_set, binv <= thr)
+        go_left = jnp.where(isnan & ~cat,
+                            jnp.take(default_left, node), go_left)
         nxt = jnp.where(go_left, jnp.take(left_child, node),
                         jnp.take(right_child, node))
         node = jnp.where(internal, nxt, node)
@@ -81,5 +92,6 @@ def predict_bins_value(tree, nan_bin_pf: jax.Array,
     """Per-row unshrunk leaf output of one device tree ([R] f32)."""
     leaf_node = predict_bins_leaf(
         tree.split_feature, tree.threshold_bin, tree.default_left,
-        tree.is_cat, tree.left_child, tree.right_child, nan_bin_pf, bins)
+        tree.is_cat, tree.left_child, tree.right_child, tree.cat_bitset,
+        nan_bin_pf, bins)
     return jnp.take(tree.node_value, leaf_node)

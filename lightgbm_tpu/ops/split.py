@@ -127,7 +127,8 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
                      leaf_hi: Optional[jax.Array] = None,
                      parent_output: Optional[jax.Array] = None,
                      slot_depth: Optional[jax.Array] = None,
-                     rand_bin: Optional[jax.Array] = None
+                     rand_bin: Optional[jax.Array] = None,
+                     cat_sorted_mask: Optional[jax.Array] = None
                      ) -> Dict[str, jax.Array]:
     """Vectorized best split per leaf.
 
@@ -148,12 +149,17 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
       slot_depth: optional [L] int32 — leaf depth, for monotone_penalty.
       rand_bin: optional [L, F] int32 — extra-trees random threshold;
         only this bin is evaluated per (leaf, feature).
+      cat_sorted_mask: optional [F] bool — categorical features with more
+        than max_cat_to_onehot bins; they take the sorted-subset path
+        (ops/cat_split.py) instead of one-hot.
 
     Returns dict with per-leaf arrays:
       gain [L] — NET gain (split - parent - min_gain_to_split, penalized;
         -inf when no valid split), feature [L], threshold [L],
       default_left [L] bool, left_sum/right_sum [L, 3],
-      left_out/right_out [L] (constrained outputs), is_cat_split [L].
+      left_out/right_out [L] (constrained outputs), is_cat_split [L],
+      cat_bitset [L, ceil(B/32)] uint32 — bin-space LEFT subset for
+        categorical winners (single bit for one-hot).
     """
     L, F, B, _ = hist.shape
     l1, l2 = params.lambda_l1, params.lambda_l2
@@ -187,10 +193,13 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     num_valid = (t_valid[:, :, None] & opt_valid[:, None, :]
                  & (~is_cat)[:, None, None])[None]             # [1, F, B, 2]
 
-    # ---- categorical one-hot: left = {bin == t}
+    # ---- categorical one-hot: left = {bin == t}; sorted-path features are
+    # excluded here (reference picks ONE path by bin count, not best-of-both)
+    onehot_f = (is_cat & ~cat_sorted_mask) if cat_sorted_mask is not None \
+        else is_cat
     cat_left = hist[:, :, :, None, :]                           # reuse lattice
     cat_right = tot[:, :, :, None, :] - cat_left
-    cat_ok = (bins_iota[None, :] < nnb[:, None]) & is_cat[:, None]
+    cat_ok = (bins_iota[None, :] < nnb[:, None]) & onehot_f[:, None]
     cat_valid = (cat_ok[:, :, None]
                  & jnp.array([True, False])[None, None, :])[None]
 
@@ -281,7 +290,7 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         af = a.reshape(L, F * B * 2)
         return jnp.take_along_axis(af, best[:, None], axis=1)[:, 0]
 
-    return {
+    out = {
         "gain": best_gain,
         "feature": feat,
         "threshold": thr,
@@ -293,3 +302,39 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         "is_cat_split": jnp.take_along_axis(
             is_cat[None, :].repeat(L, 0), feat[:, None], axis=1)[:, 0],
     }
+
+    # one-hot winners' membership mask (single bin goes left)
+    member = ((bins_iota[None, :] == thr[:, None])
+              & out["is_cat_split"][:, None]
+              & jnp.isfinite(best_gain)[:, None])               # [L, B]
+
+    if cat_sorted_mask is not None:
+        from .cat_split import find_best_cat_sorted
+        srt = find_best_cat_sorted(
+            hist, num_bins_per_feat, cat_sorted_mask, params, pg,
+            feature_mask=feature_mask, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+            parent_output=parent_output, rand_bin=rand_bin)
+        pick = srt["gain"] > out["gain"]
+        out["gain"] = jnp.where(pick, srt["gain"], out["gain"])
+        out["feature"] = jnp.where(pick, srt["feature"], out["feature"])
+        out["threshold"] = jnp.where(pick, 0, out["threshold"])
+        out["default_left"] = jnp.where(pick, False, out["default_left"])
+        out["left_sum"] = jnp.where(pick[:, None], srt["left_sum"],
+                                    out["left_sum"])
+        out["right_sum"] = jnp.where(pick[:, None], srt["right_sum"],
+                                     out["right_sum"])
+        out["left_out"] = jnp.where(pick, srt["left_out"], out["left_out"])
+        out["right_out"] = jnp.where(pick, srt["right_out"],
+                                     out["right_out"])
+        out["is_cat_split"] = jnp.where(pick, True, out["is_cat_split"])
+        member = jnp.where(pick[:, None], srt["member"], member)
+
+    # pack [L, B] membership into uint32 words (tree.h cat bitset layout)
+    BW = (B + 31) // 32
+    pad = BW * 32 - B
+    member_p = jnp.pad(member, ((0, 0), (0, pad)))
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    out["cat_bitset"] = jnp.sum(
+        member_p.reshape(L, BW, 32).astype(jnp.uint32) * weights[None, None],
+        axis=2, dtype=jnp.uint32)
+    return out

@@ -55,6 +55,8 @@ class Tree:
         self.num_cat = 0
         self.cat_boundaries = [0]
         self.cat_threshold: List[int] = []
+        # bin-space subsets per cat split (in-session binned replay only)
+        self.cat_bitset_bins: List[np.ndarray] = []
 
     # ------------------------------------------------------------------
     @classmethod
@@ -87,6 +89,7 @@ class Tree:
         thrb = np.asarray(t.threshold_bin)
         dl = np.asarray(t.default_left)
         cat = np.asarray(t.is_cat)
+        bitset = np.asarray(t.cat_bitset)
         rc = np.asarray(t.right_child)
         gain = np.asarray(t.gain)
         val = np.asarray(t.node_value)
@@ -103,8 +106,13 @@ class Tree:
             if cat[n]:
                 dt |= _CAT_BIT
                 tree.threshold[i] = tree.num_cat  # index into cat storage
+                # decode the bin-space subset, map bins -> category values
+                words = bitset[n].astype(np.uint32)
+                bin_ids = [w * 32 + b for w in range(len(words))
+                           for b in range(32) if (int(words[w]) >> b) & 1]
                 tree._append_cat_bitset(
-                    [int(mapper.categories[int(thrb[n])])])
+                    [int(mapper.categories[bi]) for bi in bin_ids])
+                tree.cat_bitset_bins.append(words)
             else:
                 dt |= (mapper.missing_type & 3) << _MISSING_SHIFT
                 if dl[n]:
