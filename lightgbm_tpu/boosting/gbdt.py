@@ -155,6 +155,28 @@ class GBDT:
                 jnp.asarray(to_kr(v, dd.r_pad))
                 for v, dd in zip(valid_init_row_scores, self.valid_dd)]
             self._init_scores = np.zeros(self.K)
+        # NOTE: when init_row_scores (init_model) is present it takes
+        # precedence over Dataset.init_score — same as the reference,
+        # where the predictor path overrides a user init_score
+        # (basic.py:2219-2223 `elif init_score is not None`).
+        elif self.train_set.get_init_score() is not None:
+            # Metadata init_score: per-row base offsets added to scores
+            # before any boosting (ScoreUpdater ctor / dataset.h:126);
+            # BoostFromAverage is skipped (gbdt.cpp:319 has_init_score
+            # guard) and no AddBias folds into the first tree, so
+            # prediction excludes the offset exactly like the reference.
+            self.scores = jnp.asarray(self._field_init_scores(
+                self.train_set.get_init_score(), self.train_set.num_data, R))
+            self.valid_scores = []
+            for v, dd in zip(self.valid_sets, self.valid_dd):
+                vi = v.get_init_score()
+                if vi is not None:
+                    self.valid_scores.append(jnp.asarray(
+                        self._field_init_scores(vi, v.num_data, dd.r_pad)))
+                else:
+                    self.valid_scores.append(
+                        jnp.zeros((self.K, dd.r_pad), jnp.float32))
+            self._init_scores = np.zeros(self.K)
         else:
             self.scores = jnp.zeros((self.K, R), jnp.float32)
             if self.config.boost_from_average and objective is not None:
@@ -212,6 +234,25 @@ class GBDT:
 
         self._update_score_jit = jax.jit(self._update_score_impl)
         self._goss_jit = jax.jit(self._goss_impl)
+
+    # ------------------------------------------------------------------
+    def _field_init_scores(self, init, n: int, r_pad: int) -> np.ndarray:
+        """Metadata init_score -> [K, r_pad] f32.
+
+        Accepts [n], [n, K], or flat [n*K] laid out class-major (the
+        reference's per-class contiguous blocks, metadata.cpp:120-129)."""
+        a = np.asarray(init, np.float32)
+        if a.ndim == 2:
+            a = a.T  # [K, n]
+        elif a.size == n * self.K and self.K > 1:
+            a = a.reshape(self.K, n)
+        else:
+            if a.size != n:
+                raise ValueError(
+                    f"init_score size {a.size} does not match num_data {n}"
+                    f" (num_model_per_iteration={self.K})")
+            a = np.broadcast_to(a.reshape(1, n), (self.K, n))
+        return _pad_rows(np.ascontiguousarray(a.T), r_pad).T
 
     # ------------------------------------------------------------------
     def _parse_monotone_constraints(self) -> Optional[jax.Array]:

@@ -19,6 +19,7 @@ Design differences (TPU-first):
 
 from __future__ import annotations
 
+import os
 import numpy as np
 from typing import Any, Dict, List, Optional, Sequence, Union
 
@@ -78,7 +79,46 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        if self.reference is not None:
+            # a valid set needs its train set's bin mappers (and, for
+            # LibSVM, its width) before anything else happens
+            self.reference.construct()
+        file_names: Optional[List[str]] = None
+        from_file = isinstance(self._raw_data, (str, os.PathLike))
+        if from_file:
+            # text-file path: CSV/TSV/LibSVM autodetect + sidecars
+            # (DatasetLoader::LoadFromFile, dataset_loader.cpp:203)
+            from .io import load_data_file
+            hint = (self.reference.num_total_features
+                    if self.reference is not None else 0)
+            loaded = load_data_file(self._raw_data, self.config,
+                                    num_features_hint=hint)
+            self._raw_data = loaded.X
+            file_names = loaded.feature_names
+            if self.label is None and loaded.label is not None:
+                self.label = loaded.label
+            if self.weight is None and loaded.weight is not None:
+                self.weight = loaded.weight
+            if self.group is None and loaded.group is not None:
+                self.group = loaded.group
+            if self.init_score is None and loaded.init_score is not None:
+                self.init_score = loaded.init_score
         data = _to_2d_float(self._raw_data)
+        if (self.reference is not None
+                and data.shape[1] != self.reference.num_total_features):
+            if from_file and data.shape[1] < \
+                    self.reference.num_total_features:
+                # LibSVM valid file whose max feature index is below the
+                # train set's: right-pad with zeros to align (CreateValid
+                # semantics — absent sparse entries are zero)
+                pad = self.reference.num_total_features - data.shape[1]
+                data = np.concatenate(
+                    [data, np.zeros((data.shape[0], pad))], axis=1)
+            else:
+                raise ValueError(
+                    f"validation data has {data.shape[1]} features but "
+                    f"training data has "
+                    f"{self.reference.num_total_features}")
         self.num_data, self.num_total_features = data.shape
         cfg = self.config
 
@@ -86,6 +126,8 @@ class Dataset:
             names = list(self.feature_name)
         elif hasattr(self._raw_data, "columns"):
             names = [str(c) for c in self._raw_data.columns]
+        elif file_names and len(file_names) == self.num_total_features:
+            names = file_names
         else:
             names = [f"Column_{i}" for i in range(self.num_total_features)]
         self.feature_name = names

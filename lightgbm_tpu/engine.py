@@ -322,6 +322,13 @@ class Booster:
         if isinstance(data, Dataset):
             raise TypeError("Cannot predict on a Dataset; pass the raw "
                             "matrix (reference basic.py behavior)")
+        import os as _os
+        if isinstance(data, (str, _os.PathLike)):
+            # predict straight from a data file (Predictor's file path,
+            # predictor.hpp:30); label column is dropped by the loader
+            from .io import load_data_file
+            data = load_data_file(
+                data, num_features_hint=len(self._feature_names)).X
         if hasattr(data, "values") and hasattr(data, "columns"):
             data = data.values
         arr = np.asarray(data, dtype=np.float64)

@@ -1,0 +1,286 @@
+"""Text-file data loading: CSV/TSV/LibSVM autodetect + metadata sidecars.
+
+TPU-native analog of the reference's text data path
+(``src/io/parser.cpp:317`` ``Parser::CreateParser`` format autodetection,
+``src/io/dataset_loader.cpp:203`` ``DatasetLoader::LoadFromFile``,
+``src/io/metadata.cpp:632,681`` sidecar ``.weight``/``.init``/``.query``
+loading).
+
+Design notes (vs the reference):
+- The reference streams the file twice (sample pass for bin mappers, then
+  feature extraction) to bound memory.  Here loading materializes a dense
+  float64 matrix on host; binning then samples from it.  The TPU training
+  path wants the whole binned matrix in HBM anyway, so two-round streaming
+  buys nothing until datasets exceed host RAM (out of scope: the binary
+  dataset cache covers the reload-cost concern instead).
+- LibSVM parsing vectorizes with NumPy over a whole file of split tokens
+  rather than per-row scalar parsing with SIMD atof
+  (``fast_double_parser``); throughput is bounded by Python string
+  splitting but load time is off the training hot path.
+
+Column semantics follow the reference config docs exactly
+(``include/LightGBM/config.h`` label_column/weight_column/group_column/
+ignore_column): indices may be given as ``N`` or ``name:colname``; for
+weight/group/ignore, integer indices DO NOT count the label column.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["LoadedFile", "load_data_file"]
+
+
+@dataclass
+class LoadedFile:
+    """Parsed text data + metadata, pre-binning."""
+    X: np.ndarray                       # [n, F] float64, NaN for missing
+    label: Optional[np.ndarray] = None  # [n]
+    weight: Optional[np.ndarray] = None
+    group: Optional[np.ndarray] = None  # per-query sizes
+    init_score: Optional[np.ndarray] = None
+    feature_names: List[str] = field(default_factory=list)
+
+
+def _read_lines(path: str) -> List[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        return [ln.rstrip("\r\n") for ln in f if ln.strip()]
+
+
+def _detect_delimiter(line: str) -> str:
+    # reference CSVParser/TSVParser selection (parser.cpp:317): pick the
+    # separator that actually splits the probe line.
+    if "\t" in line:
+        return "\t"
+    if "," in line:
+        return ","
+    return " "
+
+
+def _is_libsvm(line: str, delim: str) -> bool:
+    # a line whose non-leading tokens look like idx:value is LibSVM
+    toks = line.split() if delim == " " else line.split(delim)
+    for tok in toks[1:3]:
+        if ":" in tok:
+            head = tok.split(":", 1)[0]
+            if head.lstrip("-").isdigit():
+                return True
+    return False
+
+
+def _parse_column_spec(spec, names: List[str], *, counts_label: bool,
+                       label_idx: int) -> Optional[int]:
+    """Resolve a label/weight/group column spec to a RAW column index.
+
+    ``counts_label=False`` applies the reference's "index does not count
+    the label column" rule for weight/group/ignore specs.
+    """
+    if spec is None or spec == "":
+        return None
+    s = str(spec)
+    if s.startswith("name:"):
+        nm = s[5:]
+        if nm not in names:
+            raise ValueError(f"column name '{nm}' not found in header")
+        return names.index(nm)
+    idx = int(s)
+    if not counts_label and label_idx >= 0 and idx >= label_idx:
+        idx += 1
+    return idx
+
+
+def _parse_index_list(spec, names: List[str], label_idx: int) -> List[int]:
+    if spec is None or spec == "":
+        return []
+    s = str(spec)
+    if s.startswith("name:"):
+        out = []
+        for nm in s[5:].split(","):
+            if nm in names:
+                out.append(names.index(nm))
+        return out
+    out = []
+    for tok in s.split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        idx = int(tok)
+        if label_idx >= 0 and idx >= label_idx:
+            idx += 1
+        out.append(idx)
+    return out
+
+
+def _load_sidecar(path: str, dtype) -> Optional[np.ndarray]:
+    if not os.path.exists(path):
+        return None
+    vals = []
+    skip_first = None
+    with open(path, "r", encoding="utf-8") as f:
+        for ln in f:
+            tok = ln.strip()
+            if not tok:
+                continue
+            if skip_first is None:
+                # reference skips a non-numeric first line (header)
+                try:
+                    float(tok)
+                    skip_first = False
+                except ValueError:
+                    skip_first = True
+                    continue
+            vals.append(float(tok))
+    return np.asarray(vals, dtype=dtype)
+
+
+def _parse_delimited(lines: List[str], delim: str) -> np.ndarray:
+    rows = [ln.split(delim) for ln in lines]
+    width = max(len(r) for r in rows)
+    out = np.full((len(rows), width), np.nan, dtype=np.float64)
+    for i, r in enumerate(rows):
+        for j, tok in enumerate(r):
+            tok = tok.strip()
+            if tok == "" or tok in ("na", "NA", "nan", "NaN", "null", "None"):
+                continue
+            out[i, j] = float(tok)
+    return out
+
+
+def _parse_libsvm(lines: List[str], num_features_hint: int = 0):
+    """LibSVM `label idx:val ...` -> (labels, dense X with 0 default).
+
+    The reference treats absent LibSVM entries as zero (sparse storage);
+    we densify with 0.0, matching prediction/training semantics.
+    """
+    labels = np.empty(len(lines), dtype=np.float64)
+    idx_rows, val_rows = [], []
+    max_idx = num_features_hint - 1
+    for i, ln in enumerate(lines):
+        toks = ln.split()
+        labels[i] = float(toks[0])
+        idxs = np.empty(len(toks) - 1, dtype=np.int64)
+        vals = np.empty(len(toks) - 1, dtype=np.float64)
+        n = 0
+        for tok in toks[1:]:
+            if ":" not in tok:
+                continue
+            k, v = tok.split(":", 1)
+            idxs[n] = int(k)
+            vals[n] = float(v)
+            n += 1
+        idx_rows.append(idxs[:n])
+        val_rows.append(vals[:n])
+        if n and idxs[:n].max() > max_idx:
+            max_idx = int(idxs[:n].max())
+    X = np.zeros((len(lines), max_idx + 1), dtype=np.float64)
+    for i, (idxs, vals) in enumerate(zip(idx_rows, val_rows)):
+        X[i, idxs] = vals
+    return labels, X
+
+
+def load_data_file(path: str, config=None,
+                   num_features_hint: int = 0) -> LoadedFile:
+    """Load a CSV/TSV/LibSVM data file plus metadata sidecars.
+
+    Mirrors DatasetLoader::LoadFromFile (dataset_loader.cpp:203):
+    format autodetect, label/weight/group/ignore column extraction, then
+    ``.weight``/``.query``(or ``.group``)/``.init`` sidecar files.
+    ``num_features_hint`` pads LibSVM matrices so a test file with lower
+    max feature index aligns with its training set.
+    """
+    from .config import Config
+    cfg = config if config is not None else Config({})
+    path = str(path)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"data file not found: {path}")
+    lines = _read_lines(path)
+    if not lines:
+        raise ValueError(f"data file is empty: {path}")
+
+    has_header = bool(getattr(cfg, "header", False))
+    probe = lines[1] if has_header and len(lines) > 1 else lines[0]
+    delim = _detect_delimiter(probe)
+
+    if _is_libsvm(probe, delim):
+        body = lines[1:] if has_header else lines
+        label, X = _parse_libsvm(body, num_features_hint)
+        names = [f"Column_{i}" for i in range(X.shape[1])]
+        out = LoadedFile(X=X, label=label, feature_names=names)
+    else:
+        names: List[str] = []
+        if has_header:
+            names = [t.strip() for t in lines[0].split(delim)]
+            lines = lines[1:]
+        mat = _parse_delimited(lines, delim)
+        if not names:
+            names = [f"Column_{i}" for i in range(mat.shape[1])]
+
+        label_idx = _parse_column_spec(
+            getattr(cfg, "label_column", ""), names,
+            counts_label=True, label_idx=-1)
+        if label_idx is None:
+            label_idx = 0
+        weight_idx = _parse_column_spec(
+            getattr(cfg, "weight_column", ""), names,
+            counts_label=False, label_idx=label_idx)
+        group_idx = _parse_column_spec(
+            getattr(cfg, "group_column", ""), names,
+            counts_label=False, label_idx=label_idx)
+        ignore = _parse_index_list(
+            getattr(cfg, "ignore_column", ""), names, label_idx)
+
+        drop = {label_idx}
+        if weight_idx is not None:
+            drop.add(weight_idx)
+        if group_idx is not None:
+            drop.add(group_idx)
+        drop.update(ignore)
+        keep = [j for j in range(mat.shape[1]) if j not in drop]
+
+        label = mat[:, label_idx].copy()
+        weight = mat[:, weight_idx].copy() if weight_idx is not None else None
+        group = None
+        if group_idx is not None:
+            # group column holds a query id per row; convert to sizes
+            qid = mat[:, group_idx]
+            change = np.nonzero(np.diff(qid))[0] + 1
+            bounds = np.concatenate([[0], change, [len(qid)]])
+            group = np.diff(bounds).astype(np.int64)
+        out = LoadedFile(
+            X=np.ascontiguousarray(mat[:, keep]), label=label, weight=weight,
+            group=group, feature_names=[names[j] for j in keep])
+
+    # --- sidecars (metadata.cpp:632 .weight, :681 .init, rank .query) ---
+    w = _load_sidecar(path + ".weight", np.float64)
+    if w is not None:
+        out.weight = w
+    init = _load_sidecar(path + ".init", np.float64)
+    if init is not None:
+        out.init_score = init
+    for ext in (".query", ".group"):
+        q = _load_sidecar(path + ext, np.int64)
+        if q is not None:
+            out.group = q.astype(np.int64)
+            break
+
+    n = out.X.shape[0]
+    for nm in ("label", "weight", "group", "init_score"):
+        v = getattr(out, nm)
+        if v is None:
+            continue
+        if nm == "group":
+            if int(v.sum()) != n:
+                raise ValueError(
+                    f"query sizes sum to {int(v.sum())} != num rows {n}")
+        elif nm == "init_score":
+            if len(v) % n != 0:
+                raise ValueError(
+                    f"init_score length {len(v)} is not a multiple of "
+                    f"num rows {n}")
+        elif len(v) != n:
+            raise ValueError(f"{nm} length {len(v)} != num rows {n}")
+    return out

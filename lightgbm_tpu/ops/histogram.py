@@ -57,13 +57,23 @@ def block_rows_for(num_rows: int, num_features: int, num_bins: int) -> int:
     return _pick_block_rows(num_rows, num_features * num_bins)
 
 
+def _pvary(x, axis_name):
+    """Mark a scan carry as varying over a shard_map axis."""
+    pcast = getattr(jax.lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, axis_name, to="varying")
+    return jax.lax.pvary(x, axis_name)  # older jax
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("num_bins", "block_rows", "axis_name", "hist_dtype"))
+    static_argnames=("num_bins", "block_rows", "axis_name", "hist_dtype",
+                     "impl"))
 def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                      leaf_ids: jax.Array, *, num_bins: int,
                      block_rows: int = 0, axis_name: Optional[str] = None,
-                     hist_dtype: str = "bfloat16") -> jax.Array:
+                     hist_dtype: str = "bfloat16",
+                     impl: str = "auto") -> jax.Array:
     """Accumulate per-(leaf, feature, bin) sums of (grad, hess, count).
 
     Args:
@@ -78,6 +88,11 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         mapped axis name; histograms are psum-merged over it — the analog of
         the reference's ReduceScatter+Allgather histogram merge
         (data_parallel_tree_learner.cpp:284).
+      impl: "matmul" (MXU one-hot formulation), "scatter" (XLA scatter-add
+        — the dense_bin.hpp:105 shape, fast on CPU where XLA lowers it to
+        per-row adds, pathological on TPU), or "auto" (backend default:
+        scatter on cpu, matmul elsewhere). Both produce identical
+        histograms up to f32 accumulation order.
 
     Returns: [L, F, B, 3] float32.
     """
@@ -91,12 +106,41 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         block_rows = R
     nb = R // block_rows
     cdt = jnp.dtype(hist_dtype)
+    if impl == "auto":
+        impl = "scatter" if jax.default_backend() == "cpu" else "matmul"
 
     bins_b = bins.reshape(nb, block_rows, F)
     gh_b = gh.reshape(nb, block_rows, HIST_CH)
     leaf_b = row_leaf.reshape(nb, block_rows)
 
     iota_b = jnp.arange(B, dtype=jnp.int32)
+
+    if impl == "scatter":
+        iota_f = jnp.arange(F, dtype=jnp.int32)
+
+        def body_scatter(acc, inputs):
+            bb, ghb, lb = inputs
+            eq = lb[:, None] == leaf_ids[None, :]
+            li = jnp.argmax(eq, axis=1)
+            li = jnp.where(jnp.any(eq, axis=1), li, L)  # L = spill slot
+            flat = ((li[:, None] * F + iota_f[None, :]) * B
+                    + bb.astype(jnp.int32))              # [blk, F]
+            # round addends exactly like the matmul path's cast chain
+            vals = ghb.astype(cdt).astype(jnp.float32)
+            vals = jnp.broadcast_to(
+                vals[:, None, :], (block_rows, F, HIST_CH))
+            acc = acc.at[flat.reshape(-1)].add(
+                vals.reshape(block_rows * F, HIST_CH))
+            return acc, None
+
+        acc0 = jnp.zeros(((L + 1) * F * B, HIST_CH), dtype=jnp.float32)
+        if axis_name is not None:
+            acc0 = _pvary(acc0, axis_name)
+        acc, _ = jax.lax.scan(body_scatter, acc0, (bins_b, gh_b, leaf_b))
+        hist = acc[:L * F * B].reshape(L, F, B, HIST_CH)
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        return hist
 
     def body(acc, inputs):
         bb, ghb, lb = inputs
@@ -117,11 +161,7 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
     if axis_name is not None:
         # inside shard_map the blocked inputs vary over the mapped axis;
         # the scan carry must carry the same varying-axis type
-        pcast = getattr(jax.lax, "pcast", None)
-        if pcast is not None:
-            acc0 = pcast(acc0, axis_name, to="varying")
-        else:  # older jax
-            acc0 = jax.lax.pvary(acc0, axis_name)
+        acc0 = _pvary(acc0, axis_name)
     acc, _ = jax.lax.scan(body, acc0, (bins_b, gh_b, leaf_b))
     hist = acc.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
     if axis_name is not None:

@@ -150,47 +150,21 @@ class Tree:
     # ------------------------------------------------------------------
     def _traverse(self, X: np.ndarray) -> np.ndarray:
         """Vectorized raw-feature traversal (tree.h Predict decision path);
-        returns the leaf index per row."""
+        returns the leaf index per row. Decision semantics live in
+        _go_left_all (shared with SHAP)."""
         n = X.shape[0]
         if self.num_leaves == 1:
             return np.zeros(n, np.int32)
+        gl = self._go_left_all(X)          # [n, NI]
         node = np.zeros(n, np.int32)       # >=0: internal idx; <0: ~leaf
         active = np.ones(n, bool)
         out = np.zeros(n, np.int32)
+        rows = np.arange(n)
         for _ in range(self.num_leaves):   # depth bound
             if not active.any():
                 break
             idx = node[active]
-            f = self.split_feature[idx]
-            v = X[active, f]
-            dt = self.decision_type[idx]
-            is_cat = (dt & _CAT_BIT) != 0
-            go_left = np.zeros(len(idx), bool)
-            # numerical
-            num = ~is_cat
-            vn = v[num]
-            nan = np.isnan(vn)
-            mt = _missing_from_decision(dt[num])
-            # missing none/zero: NaN treated as 0 (c_api predict semantics)
-            vn = np.where(nan & (mt != MISSING_NAN), 0.0, vn)
-            gl = vn <= self.threshold[idx[num]]
-            defl = (dt[num] & _DEFAULT_LEFT_BIT) != 0
-            gl = np.where(nan & (mt == MISSING_NAN), defl, gl)
-            go_left[num] = gl
-            # categorical: membership in bitset
-            if is_cat.any():
-                for j in np.nonzero(is_cat)[0]:
-                    cat_idx = int(self.threshold[idx[j]])
-                    lo = self.cat_boundaries[cat_idx]
-                    hi = self.cat_boundaries[cat_idx + 1]
-                    vv = v[j]
-                    if np.isnan(vv) or vv < 0:
-                        go_left[j] = False
-                    else:
-                        c = int(vv)
-                        w = c // 32
-                        go_left[j] = (w < hi - lo) and bool(
-                            (self.cat_threshold[lo + w] >> (c % 32)) & 1)
+            go_left = gl[rows[active], idx]
             nxt = np.where(go_left, self.left_child[idx],
                            self.right_child[idx])
             node[active] = nxt
@@ -347,39 +321,204 @@ class Tree:
             return float(self.internal_count[node])
         return float(self.leaf_count[~node])
 
-    def predict_contrib(self, X: np.ndarray) -> np.ndarray:
-        """[n, num_features_used + 1] SHAP values (last column = expected
-        value). Features indexed globally by split_feature."""
+    def predict_contrib_reference(self, X: np.ndarray) -> np.ndarray:
+        """Per-row recursive TreeSHAP — the direct transcription of the
+        reference algorithm (tree.cpp TreeSHAP). Kept as the slow oracle
+        for the vectorized path below; use predict_contrib."""
         n, F = X.shape
         out = np.zeros((n, F + 1))
         out[:, -1] = self.expected_value()
         if self.num_leaves == 1:
             return out
+        gl = self._go_left_all(X)
         for r in range(n):
-            self._tree_shap(X[r], out[r], 0, 1.0, 1.0, -1, [])
+            self._tree_shap(gl[r], out[r], 0, 1.0, 1.0, -1, [])
         return out
 
-    def _decision(self, node: int, x: np.ndarray) -> bool:
-        f = self.split_feature[node]
-        dt = int(self.decision_type[node])
-        v = x[f]
-        if dt & _CAT_BIT:
-            if np.isnan(v) or v < 0:
-                return False
-            c = int(v)
-            cat_idx = int(self.threshold[node])
-            lo, hi = self.cat_boundaries[cat_idx], \
-                self.cat_boundaries[cat_idx + 1]
-            w = c // 32
-            return (w < hi - lo) and bool(
-                (self.cat_threshold[lo + w] >> (c % 32)) & 1)
-        if np.isnan(v):
-            if _missing_from_decision(dt) == MISSING_NAN:
-                return bool(dt & _DEFAULT_LEFT_BIT)
-            v = 0.0
-        return v <= self.threshold[node]
+    # -- vectorized TreeSHAP ------------------------------------------
+    # The recursion above walks EXTEND/UNWIND per (row, node). The
+    # vectorized form exploits two structural facts:
+    # (1) at a leaf, the EXTEND polynomial is a symmetric function of the
+    #     path's UNIQUE features with merged fractions (duplicate feature
+    #     occurrences multiply: one = AND of direction matches, zero =
+    #     product of cover ratios) — extend order never matters;
+    # (2) per row, one_fraction is BINARY, so the whole row dependence is
+    #     a [rows, leaves, slots] 0/1 tensor of "did this row follow the
+    #     path at every node of this feature".
+    # So: precompute per-leaf path slot tables once per tree (host), then
+    # run the EXTEND scan and the per-slot UNWIND totals as NumPy array
+    # programs over (rows x leaves x slots) — Python loop counts are
+    # O(depth) and O(depth) instead of O(rows * nodes * depth^2).
+    def _path_data(self):
+        if getattr(self, "_paths_cache", None) is not None:
+            return self._paths_cache
+        L = self.num_leaves
+        raw_paths = [None] * L  # leaf slot -> (nodes, dirs)
+        stack = [(0, [], [])]
+        while stack:
+            node, nodes, dirs = stack.pop()
+            if node < 0:
+                raw_paths[~node] = (nodes, dirs)
+                continue
+            stack.append((int(self.left_child[node]), nodes + [node],
+                          dirs + [1]))
+            stack.append((int(self.right_child[node]), nodes + [node],
+                          dirs + [0]))
+        P = max(len(p[0]) for p in raw_paths)
+        slot_lists = []
+        for nodes, dirs in raw_paths:
+            feats = {}
+            for p, (nd, dr) in enumerate(zip(nodes, dirs)):
+                feats.setdefault(int(self.split_feature[nd]), []).append(p)
+            slot_lists.append(list(feats.items()))
+        D = max(len(s) for s in slot_lists)
 
-    def _tree_shap(self, x, phi, node, p_zero, p_one, p_feat, path):
+        path_node = np.full((L, P), -1, np.int32)
+        path_dir = np.zeros((L, P), np.int8)
+        path_slot = np.full((L, P), -1, np.int32)
+        slot_feat = np.full((L, D), -1, np.int32)
+        slot_zero = np.ones((L, D), np.float64)
+        d_len = np.zeros(L, np.int32)
+        for l, ((nodes, dirs), slots) in enumerate(zip(raw_paths,
+                                                       slot_lists)):
+            path_node[l, :len(nodes)] = nodes
+            path_dir[l, :len(dirs)] = dirs
+            d_len[l] = len(slots)
+            for s, (f, occs) in enumerate(slots):
+                slot_feat[l, s] = f
+                for p in occs:
+                    path_slot[l, p] = s
+                    nd = nodes[p]
+                    child = (int(self.left_child[nd]) if dirs[p]
+                             else int(self.right_child[nd]))
+                    w = self._node_weight(nd)
+                    slot_zero[l, s] *= (self._node_weight(child) / w
+                                        if w > 0 else 0.0)
+        # mismatch-count map [L, P, D]: path position -> slot one-hot
+        slot_map = np.zeros((L, P, D), np.float64)
+        for l in range(L):
+            for p in range(P):
+                if path_slot[l, p] >= 0:
+                    slot_map[l, p, path_slot[l, p]] = 1.0
+        # scatter groups: feature id -> (leaf idx array, slot idx array)
+        groups = {}
+        for l in range(L):
+            for s in range(int(d_len[l])):
+                ls, ss = groups.setdefault(int(slot_feat[l, s]), ([], []))
+                ls.append(l)
+                ss.append(s)
+        groups = {f: (np.asarray(ls, np.intp), np.asarray(ss, np.intp))
+                  for f, (ls, ss) in groups.items()}
+        self._paths_cache = (path_node, path_dir, slot_map, slot_feat,
+                             slot_zero, d_len, groups)
+        return self._paths_cache
+
+    def _go_left_all(self, X: np.ndarray) -> np.ndarray:
+        """[n, num_internal] decision per row per internal node (the same
+        semantics as _decision, batched)."""
+        n = X.shape[0]
+        ni = self.num_leaves - 1
+        v = X[:, self.split_feature]                     # [n, NI]
+        dt = self.decision_type
+        is_cat = (dt & _CAT_BIT) != 0
+        out = np.zeros((n, ni), bool)
+        num = ~is_cat
+        if num.any():
+            vn = v[:, num]
+            nan = np.isnan(vn)
+            mt = _missing_from_decision(dt[num])
+            vn = np.where(nan & (mt != MISSING_NAN), 0.0, vn)
+            gl = vn <= self.threshold[num]
+            defl = (dt[num] & _DEFAULT_LEFT_BIT) != 0
+            out[:, num] = np.where(nan & (mt == MISSING_NAN), defl, gl)
+        for j in np.nonzero(is_cat)[0]:
+            cat_idx = int(self.threshold[j])
+            lo = self.cat_boundaries[cat_idx]
+            hi = self.cat_boundaries[cat_idx + 1]
+            words = np.asarray(self.cat_threshold[lo:hi], np.int64)
+            vv = v[:, j]
+            valid = ~np.isnan(vv) & (vv >= 0)
+            c = np.where(valid, vv, 0).astype(np.int64)
+            w = c >> 5
+            ok = w < (hi - lo)
+            bits = (words[np.clip(w, 0, max(hi - lo - 1, 0))]
+                    >> (c & 31)) & 1
+            out[:, j] = valid & ok & bits.astype(bool)
+        return out
+
+    def predict_contrib(self, X: np.ndarray,
+                        row_chunk: int = 0) -> np.ndarray:
+        """[n, num_features + 1] SHAP values (last column = expected
+        value); vectorized TreeSHAP (see block comment above)."""
+        n, F = X.shape
+        phi = np.zeros((n, F + 1))
+        phi[:, -1] = self.expected_value()
+        if self.num_leaves == 1:
+            return phi
+        (path_node, path_dir, slot_map, slot_feat, slot_zero, d_len,
+         groups) = self._path_data()
+        L, P = path_node.shape
+        D = slot_feat.shape[1]
+        go_left = self._go_left_all(X)                   # [n, NI]
+        if row_chunk <= 0:
+            row_chunk = max(1, (1 << 24) // max(L * (D + 1), 1))
+
+        karr = d_len.astype(np.float64)[None, :, None]   # [1, L, 1]
+        kp1 = karr + 1.0
+        valid_slot = (np.arange(D)[None, :] < d_len[:, None])  # [L, D]
+        w_idx = np.arange(D + 1, dtype=np.float64)
+        leaf_val = self.leaf_value[None, :, None]        # [1, L, 1]
+
+        for lo_r in range(0, n, row_chunk):
+            sl = slice(lo_r, min(lo_r + row_chunk, n))
+            c = sl.stop - sl.start
+            # match per path position; padding positions always match
+            m = go_left[sl][:, np.clip(path_node, 0, None)] \
+                == (path_dir[None, :, :] != 0)           # [c, L, P]
+            mism = (~m & (path_node >= 0)[None]).astype(np.float64)
+            one = (np.einsum("clp,lpd->cld", mism, slot_map) == 0) \
+                .astype(np.float64)                      # [c, L, D]
+            # EXTEND: pw[p] <- zero*pw[p]*(m-p)/(m+1) + one*pw[p-1]*p/(m+1)
+            pw = np.zeros((c, L, D + 1))
+            pw[..., 0] = 1.0
+            for step in range(1, D + 1):
+                vmask = valid_slot[:, step - 1][None, :, None]  # [1, L, 1]
+                o = one[:, :, step - 1][:, :, None]
+                z = slot_zero[:, step - 1][None, :, None]
+                shifted = np.concatenate(
+                    [np.zeros((c, L, 1)), pw[..., :-1]], axis=2)
+                new = (z * pw * np.maximum(step - w_idx, 0.0)
+                       + o * shifted * w_idx) / (step + 1.0)
+                pw = np.where(vmask, new, pw)
+            # UNWIND totals per excluded slot i (vectorized over i)
+            tmp = np.take_along_axis(
+                pw, d_len[None, :, None].astype(np.intp), axis=2)
+            tmp = np.broadcast_to(tmp, (c, L, D)).copy()
+            total = np.zeros((c, L, D))
+            one_b = one != 0
+            with np.errstate(divide="ignore", invalid="ignore"):
+                for j in range(D - 1, -1, -1):
+                    active = (j < d_len)[None, :, None]
+                    pwj = pw[:, :, j:j + 1]
+                    t = tmp * kp1 / (j + 1.0)
+                    total1 = total + t
+                    tmp1 = pwj - t * slot_zero[None] * (karr - j) / kp1
+                    total0 = total + pwj * kp1 / (slot_zero[None]
+                                                  * (karr - j))
+                    total = np.where(
+                        active, np.where(one_b, total1, total0), total)
+                    tmp = np.where(active & one_b, tmp1, tmp)
+            contrib = np.where(
+                valid_slot[None], total * (one - slot_zero[None]) * leaf_val,
+                0.0)                                     # [c, L, D]
+            for f, (ls, ss) in groups.items():
+                phi[sl, f] += contrib[:, ls, ss].sum(axis=1)
+        return phi
+
+    def _tree_shap(self, gl_row, phi, node, p_zero, p_one, p_feat, path):
+        # gl_row: [num_internal] bool — this row's decisions, precomputed
+        # by _go_left_all so the missing/categorical semantics live in
+        # exactly one place
         # path: list of [feat, zero_frac, one_frac, pweight]; elements are
         # deep-copied — EXTEND mutates weights and the hot/cold branches
         # must not see each other's updates
@@ -409,7 +548,7 @@ class Tree:
                 phi[path[i][0]] += total * (onew - zerow) * leaf_val
             return
         hot, cold = ((self.left_child[node], self.right_child[node])
-                     if self._decision(node, x)
+                     if gl_row[node]
                      else (self.right_child[node], self.left_child[node]))
         w = self._node_weight(node)
         hot_zero = self._node_weight(hot) / w if w > 0 else 0.0
@@ -422,9 +561,9 @@ class Tree:
         if prev is not None:
             incoming_zero, incoming_one = path[prev][1], path[prev][2]
             path = self._unwind(path, prev)
-        self._tree_shap(x, phi, hot, incoming_zero * hot_zero,
+        self._tree_shap(gl_row, phi, hot, incoming_zero * hot_zero,
                         incoming_one, f, path)
-        self._tree_shap(x, phi, cold, incoming_zero * cold_zero,
+        self._tree_shap(gl_row, phi, cold, incoming_zero * cold_zero,
                         0.0, f, path)
 
     @staticmethod

@@ -54,3 +54,36 @@ def test_multiclass_contrib_shape(rng):
         np.testing.assert_allclose(
             contrib[:, k * 5:(k + 1) * 5].sum(axis=1), raw[:, k],
             rtol=1e-5, atol=1e-5)
+
+
+def test_vectorized_matches_recursive_oracle(rng):
+    """The array-based TreeSHAP must agree with the per-row recursion
+    (the direct transcription of tree.cpp TreeSHAP) bit-for-bit-ish."""
+    X = rng.normal(size=(300, 6))
+    X[rng.rand(300, 6) < 0.1] = np.nan
+    y = (X[:, 0] + np.nan_to_num(X[:, 1]) ** 2
+         + rng.normal(size=300) * 0.1 > 0.4).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "min_data_in_leaf": 3, "verbosity": -1}, ds, 6)
+    Xt = rng.normal(size=(40, 6))
+    Xt[rng.rand(40, 6) < 0.1] = np.nan
+    for tree in bst._gbdt.models:
+        np.testing.assert_allclose(
+            tree.predict_contrib(Xt), tree.predict_contrib_reference(Xt),
+            rtol=1e-9, atol=1e-12)
+
+
+def test_vectorized_contrib_categorical(rng):
+    X = rng.normal(size=(500, 4))
+    X[:, 3] = rng.randint(0, 12, size=500)
+    y = X[:, 0] + (X[:, 3] % 3 == 1) * 2.0
+    ds = lgb.Dataset(X, label=y, categorical_feature=[3],
+                     free_raw_data=False)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1}, ds, 5)
+    Xt = X[:60]
+    for tree in bst._gbdt.models:
+        np.testing.assert_allclose(
+            tree.predict_contrib(Xt), tree.predict_contrib_reference(Xt),
+            rtol=1e-9, atol=1e-12)
