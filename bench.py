@@ -89,6 +89,52 @@ def init_backend(retries: int = 2, probe_timeout_s: float = 300.0) -> str:
         raise SystemExit(1)
 
 
+def probe_hist_impl(platform: str) -> dict:
+    """Choose the histogram kernel for this run and micro-bench it.
+
+    On TPU the default is the fused Pallas kernel; if its lowering fails
+    on this chip/toolchain, fall back to the XLA one-hot matmul and say
+    so in the output instead of dying. Returns dict of report fields.
+    """
+    import numpy as np
+    import jax
+    from lightgbm_tpu.ops.histogram import build_histograms
+
+    out = {"hist_impl": "scatter" if platform == "cpu" else "matmul"}
+    rng = np.random.RandomState(3)
+    R, F, B, L = 1 << 17, 28, 63, 21
+    bins = rng.randint(0, B, size=(R, F)).astype(np.uint8)
+    gh = rng.normal(size=(R, 3)).astype(np.float32)
+    rl = rng.randint(0, 2 * L, size=R).astype(np.int32)
+    lids = np.arange(L, dtype=np.int32)
+
+    def bench_one(impl):
+        fn = lambda: build_histograms(  # noqa: E731
+            bins, gh, rl, lids, num_bins=B, hist_dtype="bfloat16",
+            impl=impl)
+        fn().block_until_ready()
+        t0 = time.time()
+        for _ in range(5):
+            h = fn()
+        h.block_until_ready()
+        return (time.time() - t0) / 5
+
+    if platform == "tpu":
+        try:
+            t_pallas = bench_one("pallas")
+            out["hist_impl"] = "pallas"
+            out["hist_pallas_ms"] = round(t_pallas * 1e3, 2)
+        except Exception as e:  # Mosaic lowering failure -> fallback
+            print(f"pallas probe failed ({type(e).__name__}: {e}); "
+                  "falling back to matmul", file=sys.stderr)
+            out["hist_impl"] = "matmul"
+        try:
+            out["hist_matmul_ms"] = round(bench_one("matmul") * 1e3, 2)
+        except Exception:
+            pass
+    return out
+
+
 def main():
     platform = init_backend()
     print(f"jax backend: {platform}", file=sys.stderr)
@@ -99,10 +145,14 @@ def main():
     max_bin = int(os.environ.get("BENCH_MAX_BIN", 63))
     warmup = 3
 
+    hist_fields = probe_hist_impl(platform)
+    print(f"histogram kernel: {hist_fields}", file=sys.stderr)
+
     X, y = make_higgs_like(n_rows)
     params = dict(objective="binary", metric="auc", num_leaves=255,
                   learning_rate=0.1, max_bin=max_bin, leaf_batch=21,
-                  min_data_in_leaf=100, verbosity=-1)
+                  min_data_in_leaf=100, verbosity=-1,
+                  hist_impl=hist_fields["hist_impl"])
 
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
@@ -130,6 +180,7 @@ def main():
         "vs_baseline": round(throughput / BASELINE_ROW_TREES_PER_S, 4),
         "platform": platform,
         "train_auc": round(float(auc), 6),
+        **hist_fields,
     }))
 
 

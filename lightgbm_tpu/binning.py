@@ -276,3 +276,33 @@ class BinMapper:
         return (f"BinMapper({self.bin_type}, num_bin={self.num_bin}, "
                 f"missing={_MISSING_NAMES[self.missing_type]}, "
                 f"trivial={self.is_trivial})")
+
+    # -- binary dataset cache serialization (SaveBinaryFile analog) -------
+    def state_arrays(self):
+        """(scalars int64[6], upper_bounds f64[*], categories i64[*]) —
+        flat arrays for the Dataset binary cache."""
+        scalars = np.asarray(
+            [self.num_bin, int(self.is_trivial), self.missing_type,
+             int(self.bin_type == "categorical"), self.most_freq_bin,
+             self.default_bin], np.int64)
+        ub = (self.bin_upper_bound if self.bin_upper_bound is not None
+              else np.empty(0, np.float64))
+        cats = (self.categories.astype(np.int64)
+                if self.categories is not None else np.empty(0, np.int64))
+        return scalars, ub, cats
+
+    @classmethod
+    def from_state_arrays(cls, scalars, ub, cats) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(scalars[0])
+        m.is_trivial = bool(scalars[1])
+        m.missing_type = int(scalars[2])
+        m.bin_type = "categorical" if scalars[3] else "numerical"
+        m.most_freq_bin = int(scalars[4])
+        m.default_bin = int(scalars[5])
+        if m.bin_type == "categorical":
+            m.categories = np.asarray(cats, np.int64)
+            m._cat_to_bin = {int(c): i for i, c in enumerate(m.categories)}
+        else:
+            m.bin_upper_bound = np.asarray(ub, np.float64)
+        return m

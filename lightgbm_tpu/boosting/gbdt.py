@@ -435,7 +435,8 @@ class GBDT:
             num_leaves=cfg.num_leaves, leaf_batch=cfg.leaf_batch,
             max_depth=cfg.max_depth, num_bins=self.B,
             split_params=self.split_params,
-            hist_dtype=cfg.hist_dtype, block_rows=self.block,
+            hist_dtype=cfg.hist_dtype, hist_impl=cfg.hist_impl,
+            block_rows=self.block,
             valid_bins=tuple(dd.bins for dd in self.valid_dd),
             valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd),
             mono_type_pf=self.mono_type_pf,

@@ -106,7 +106,7 @@ def _round_int(x):
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
-                     "split_params", "axis_name", "hist_dtype", "block_rows",
+                     "split_params", "axis_name", "hist_dtype", "hist_impl", "block_rows",
                      "feature_fraction_bynode"))
 def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
@@ -114,7 +114,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                *, num_leaves: int, leaf_batch: int, max_depth: int,
                num_bins: int, split_params: SplitParams,
                axis_name: Optional[str] = None,
-               hist_dtype: str = "bfloat16", block_rows: int = 0,
+               hist_dtype: str = "bfloat16", hist_impl: str = "auto",
+               block_rows: int = 0,
                valid_bins: Tuple[jax.Array, ...] = (),
                valid_row_leaf0: Tuple[jax.Array, ...] = (),
                mono_type_pf: Optional[jax.Array] = None,
@@ -144,7 +145,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     def hist_for(slots, rl):
         return build_histograms(
             bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
-            axis_name=axis_name, hist_dtype=hist_dtype)
+            axis_name=axis_name, hist_dtype=hist_dtype, impl=hist_impl)
 
     nnb_pf = num_bins_pf - (nan_bin_pf >= 0).astype(jnp.int32)
 

@@ -152,6 +152,11 @@ PARAMS: Dict[str, ParamSpec] = {
         _p("hist_dtype", "bfloat16", str,
            doc="matmul input dtype for histogram accumulation: bfloat16 "
                "(default; f32 accumulate) or float32 (exact)"),
+        _p("hist_impl", "auto", str,
+           check=lambda v: v in ("auto", "matmul", "scatter", "pallas"),
+           doc="histogram kernel: auto (pallas on tpu, scatter on cpu), "
+               "matmul (MXU one-hot), scatter (XLA scatter-add), pallas "
+               "(fused VMEM kernel)"),
         # -- IO / dataset --
         _p("max_bin", 255, int, aliases=("max_bins",), check=lambda v: v > 1),
         _p("max_bin_by_feature", [], list),

@@ -85,6 +85,13 @@ class Dataset:
             self.reference.construct()
         file_names: Optional[List[str]] = None
         from_file = isinstance(self._raw_data, (str, os.PathLike))
+        if from_file and self._is_binary_file(self._raw_data):
+            # binary dataset cache (LoadFromBinFile analog): restores
+            # the constructed state directly, no parsing or re-binning
+            self._load_binary(self._raw_data)
+            if self.label is None and not self.params.get("_allow_no_label"):
+                raise ValueError("Dataset has no label")
+            return self
         if from_file:
             # text-file path: CSV/TSV/LibSVM autodetect + sidecars
             # (DatasetLoader::LoadFromFile, dataset_loader.cpp:203)
@@ -254,3 +261,77 @@ class Dataset:
 
     def __len__(self):
         return self.num_data
+
+    # ------------------------------------------------------------------
+    # binary dataset cache (Dataset::SaveBinaryFile dataset.cpp:1018 /
+    # DatasetLoader::LoadFromBinFile dataset_loader.cpp:417): persist the
+    # CONSTRUCTED state — binned matrix + mappers + metadata — so reloads
+    # skip parsing and re-binning entirely.
+    _BINARY_KEY = "lightgbm_tpu_dataset_v1"
+
+    def save_binary(self, filename) -> "Dataset":
+        self.construct()
+        payload = {
+            self._BINARY_KEY: np.asarray(1),
+            "bins": self.bins,
+            "used_features": self.used_features,
+            "max_num_bin": np.asarray(self.max_num_bin),
+            "feature_name": np.asarray(self.feature_name),
+        }
+        for field in ("label", "weight", "group", "init_score"):
+            v = getattr(self, field)
+            if v is not None:
+                payload[field] = v
+        scal, ubs, cats = [], [], []
+        ub_off, cat_off = [0], [0]
+        for m in self.bin_mappers:
+            s, ub, ct = m.state_arrays()
+            scal.append(s)
+            ubs.append(ub)
+            cats.append(ct)
+            ub_off.append(ub_off[-1] + len(ub))
+            cat_off.append(cat_off[-1] + len(ct))
+        payload.update(
+            mapper_scalars=np.stack(scal),
+            mapper_ub=np.concatenate(ubs) if ubs else np.empty(0),
+            mapper_ub_off=np.asarray(ub_off, np.int64),
+            mapper_cats=np.concatenate(cats) if cats else np.empty(0,
+                                                                   np.int64),
+            mapper_cat_off=np.asarray(cat_off, np.int64))
+        with open(filename, "wb") as f:
+            np.savez_compressed(f, **payload)
+        return self
+
+    @staticmethod
+    def _is_binary_file(path) -> bool:
+        try:
+            with open(path, "rb") as f:
+                return f.read(2) == b"PK"  # npz = zip container
+        except OSError:
+            return False
+
+    def _load_binary(self, path):
+        from .binning import BinMapper
+        with np.load(path, allow_pickle=False) as z:
+            if self._BINARY_KEY not in z:
+                raise ValueError(
+                    f"{path} is not a lightgbm_tpu binary dataset")
+            self.bins = z["bins"]
+            self.used_features = z["used_features"]
+            self.max_num_bin = int(z["max_num_bin"])
+            self.feature_name = [str(s) for s in z["feature_name"]]
+            for field in ("label", "weight", "group", "init_score"):
+                if field in z and getattr(self, field) is None:
+                    setattr(self, field, z[field])
+            scal = z["mapper_scalars"]
+            ub, ub_off = z["mapper_ub"], z["mapper_ub_off"]
+            cats, cat_off = z["mapper_cats"], z["mapper_cat_off"]
+        self.bin_mappers = [
+            BinMapper.from_state_arrays(
+                scal[i], ub[ub_off[i]:ub_off[i + 1]],
+                cats[cat_off[i]:cat_off[i + 1]])
+            for i in range(scal.shape[0])]
+        self.num_data, _ = self.bins.shape
+        self.num_total_features = len(self.bin_mappers)
+        self._raw_data = None
+        self._constructed = True

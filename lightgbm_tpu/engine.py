@@ -253,7 +253,11 @@ class Booster:
         metrics = self._metrics if which < 0 else self._valid_metrics[which]
         out = []
         for m in metrics:
-            for mname, value, bigger in m.eval(np.asarray(pred, np.float64)):
+            # metrics like auc_mu rank by linear combinations of RAW
+            # scores (the reference passes raw + objective to every
+            # metric; we only fork where the distinction matters)
+            inp = raw if getattr(m, "needs_raw_score", False) else pred
+            for mname, value, bigger in m.eval(np.asarray(inp, np.float64)):
                 out.append((name, mname, value, bigger))
         if feval is not None:
             ds = self.train_set if which < 0 else self._valid_sets[which]
@@ -376,6 +380,59 @@ class Booster:
             tail.append(f"[{key}: {val}]")
         tail += ["end of parameters", "", "pandas_categorical:null", ""]
         return "\n".join(header) + "\n" + body + "\n".join(tail)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict[str, Any]:
+        """Model as a JSON-ready dict (GBDT::DumpModel,
+        gbdt_model_text.cpp:21; same schema as the reference python
+        Booster.dump_model)."""
+        K = max(1, self._num_class)
+        trees = self._all_trees()
+        total_iter = len(trees) // K
+        start_iteration = min(max(start_iteration, 0), total_iter)
+        start = start_iteration * K
+        end = len(trees)
+        if num_iteration is not None and num_iteration > 0:
+            end = min(start + num_iteration * K, end)
+        feature_infos = {}
+        for name, info in zip(self._feature_names,
+                              self._feature_infos_list()):
+            if info == "none":
+                continue
+            if info.startswith("["):
+                lo, hi = info[1:-1].split(":")
+                feature_infos[name] = {"min_value": float(lo),
+                                       "max_value": float(hi),
+                                       "values": []}
+            else:
+                vals = [int(v) for v in info.split(":")]
+                feature_infos[name] = {"min_value": min(vals),
+                                       "max_value": max(vals),
+                                       "values": vals}
+        imp = self.feature_importance(importance_type)
+        return {
+            "name": "tree",
+            "version": "v4",
+            "num_class": self._num_class,
+            "num_tree_per_iteration": K,
+            "label_index": 0,
+            "max_feature_idx": self._max_feature_idx,
+            "objective": self._objective_text(),
+            "average_output": bool(self._average_output),
+            "feature_names": list(self._feature_names),
+            "monotone_constraints": [
+                int(v) for v in
+                (Config(self.params).monotone_constraints or [])],
+            "feature_infos": feature_infos,
+            "tree_info": [
+                dict(tree_index=i, **t.to_json())
+                for i, t in enumerate(trees[start:end], start=start)],
+            "feature_importances": {
+                self._feature_names[i]: float(imp[i])
+                for i in np.argsort(-imp, kind="stable") if imp[i] > 0},
+            "pandas_categorical": None,
+        }
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
@@ -574,6 +631,11 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             for name, metric, value, _ in (e.best_score or []):
                 booster.best_score.setdefault(name, {})[metric] = value
             break
+        if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
+            # periodic checkpoint (gbdt.cpp:250-254): full model text,
+            # resumable via init_model
+            booster.save_model(
+                f"{cfg.output_model}.snapshot_iter_{i + 1}")
         if stop:
             break
     return booster

@@ -32,7 +32,27 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["LoadedFile", "load_data_file"]
+__all__ = ["LoadedFile", "load_data_file", "parse_config_file"]
+
+
+def parse_config_file(path: str) -> dict:
+    """Parse a LightGBM ``train.conf``-style file into a params dict.
+
+    Mirrors Config::KV2Map + Application::LoadParameters
+    (``src/io/config.cpp``, ``src/application/application.cpp:31-86``):
+    ``key = value`` lines, ``#`` comments stripped, FIRST occurrence of a
+    duplicated key wins (KeepFirstValues semantics). Values stay strings;
+    Config coerces types downstream.
+    """
+    params = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            params.setdefault(k.strip(), v.strip())
+    return params
 
 
 @dataclass

@@ -227,6 +227,72 @@ class MultiLogloss(Metric):
         return [(self.name, self._avg(-np.log(p)), False)]
 
 
+class AucMu(Metric):
+    """AUC-mu (multiclass_metric.hpp:183, Kleiman & Page 2019): mean over
+    class pairs (i, j) of the AUC of samples of those classes ranked by
+    their distance from the pair's separating direction,
+    ``dist = (v_i - v_j) * (v . raw_score)`` with
+    ``v = weights[i] - weights[j]``. Supports the ``auc_mu_weights``
+    K*K matrix (row-major, like config.cpp:220-232); default is all-ones
+    with a zero diagonal. Ranks raw scores (needs_raw_score), exactly as
+    the reference does.
+    """
+    name = "auc_mu"
+    bigger_is_better = True
+    needs_raw_score = True
+
+    def _weights_matrix(self, K: int) -> np.ndarray:
+        wm = self.cfg.auc_mu_weights
+        if wm:
+            wm = np.asarray(wm, np.float64)
+            if wm.size != K * K:
+                raise ValueError(
+                    f"auc_mu_weights must have {K * K} entries, got "
+                    f"{wm.size}")
+            return wm.reshape(K, K)
+        out = np.ones((K, K))
+        np.fill_diagonal(out, 0.0)
+        return out
+
+    def eval(self, score):
+        y = self.label.astype(np.int64)
+        score = np.asarray(score, np.float64)
+        if score.ndim == 1:
+            score = score[:, None]
+        K = score.shape[1]
+        if K < 2:
+            raise ValueError(
+                "auc_mu requires a multiclass model (num_class >= 2); "
+                f"got {K} score column(s)")
+        W = self._weights_matrix(K)
+        w = self.weight
+        ans = 0.0
+        for i in range(K):
+            mi = y == i
+            if not mi.any():
+                continue
+            for j in range(i + 1, K):
+                mj = y == j
+                if not mj.any():
+                    continue
+                v = W[i] - W[j]
+                t1 = v[i] - v[j]
+                di = t1 * (score[mi] @ v)
+                dj = t1 * (score[mj] @ v)
+                wi = w[mi] if w is not None else np.ones(int(mi.sum()))
+                wj = w[mj] if w is not None else np.ones(int(mj.sum()))
+                order = np.argsort(dj, kind="stable")
+                djs = dj[order]
+                cw = np.concatenate([[0.0], np.cumsum(wj[order])])
+                left = np.searchsorted(djs, di, side="left")
+                right = np.searchsorted(djs, di, side="right")
+                # class-j weight strictly below + half the tied weight
+                s = np.sum(wi * (cw[left] + 0.5 * (cw[right] - cw[left])))
+                ans += s / (wi.sum() * wj.sum())
+        ans = 2.0 * ans / (K * (K - 1))
+        return [(self.name, float(ans), True)]
+
+
 class MultiError(Metric):
     name = "multi_error"
 
@@ -369,6 +435,7 @@ _REGISTRY = {
     "multi_logloss": MultiLogloss, "multiclass": MultiLogloss,
     "softmax": MultiLogloss, "multiclassova": MultiLogloss,
     "multi_error": MultiError,
+    "auc_mu": AucMu,
     "cross_entropy": XentropyMetric, "xentropy": XentropyMetric,
     "cross_entropy_lambda": XentLambdaMetric, "xentlambda": XentLambdaMetric,
     "kldiv": KullbackLeibler, "kullback_leibler": KullbackLeibler,

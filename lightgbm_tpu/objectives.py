@@ -338,13 +338,15 @@ class MulticlassSoftmax(Objective):
         super().init(label, weight, query_boundaries)
 
     def get_gradients(self, score, label, weight):
-        # score: [R, K]; one-vs-all softmax grads; factor 2 on the hessian
-        # matches the reference's diagonal approximation.
+        # score: [R, K]; softmax grads with the reference's hessian
+        # scaling factor K/(K-1) (multiclass_objective.hpp:31 factor_;
+        # equals the familiar 2.0 only at K=2)
         p = jax.nn.softmax(score, axis=1)
         y = jax.nn.one_hot(label.astype(jnp.int32), self.num_class,
                            dtype=score.dtype)
         g = p - y
-        h = 2.0 * p * (1.0 - p)
+        factor = self.num_class / max(self.num_class - 1.0, 1.0)
+        h = factor * p * (1.0 - p)
         if weight is not None:
             g, h = g * weight[:, None], h * weight[:, None]
         return g, h

@@ -107,7 +107,22 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
     nb = R // block_rows
     cdt = jnp.dtype(hist_dtype)
     if impl == "auto":
-        impl = "scatter" if jax.default_backend() == "cpu" else "matmul"
+        backend = jax.default_backend()
+        if backend == "tpu":
+            impl = "pallas"      # fused VMEM one-hot (pallas_histogram)
+        elif backend == "cpu":
+            impl = "scatter"     # XLA lowers to per-row adds
+        else:
+            impl = "matmul"
+
+    if impl == "pallas":
+        from .pallas_histogram import build_histograms_pallas
+        hist = build_histograms_pallas(
+            bins, gh, row_leaf, leaf_ids, num_bins=B,
+            hist_dtype=hist_dtype)
+        if axis_name is not None:
+            hist = jax.lax.psum(hist, axis_name)
+        return hist
 
     bins_b = bins.reshape(nb, block_rows, F)
     gh_b = gh.reshape(nb, block_rows, HIST_CH)

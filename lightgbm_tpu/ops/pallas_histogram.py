@@ -1,0 +1,174 @@
+"""Pallas TPU histogram kernel — the fused hot loop.
+
+The XLA formulation in ops/histogram.py materializes a ``[block, F*B]``
+bf16 one-hot in HBM and feeds it to the MXU; at Higgs scale that is ~14GB
+of HBM traffic per histogram build, and HBM bandwidth — not MXU FLOPs —
+is the TPU bottleneck (reference hot loop analog:
+``src/io/dense_bin.hpp:105`` ConstructHistogram,
+``src/treelearner/cuda/cuda_histogram_constructor.cu`` shared-memory
+kernels). This kernel builds the one-hot *in VMEM* per (row-block,
+feature-chunk) grid step, multiplies on the MXU, and accumulates into a
+VMEM-resident output block — the one-hot never touches HBM. HBM traffic
+drops to the irreducible streams: bins [R, Fc] uint8 + gh [R, 3] in,
+hist [F*B, L*3] out.
+
+Grid: ``(feature_chunks, row_blocks)`` with rows innermost, so each
+feature chunk's accumulator stays pinned in VMEM across the whole row
+stream (TPU grids execute sequentially; revisiting the same out block is
+the standard reduction pattern).
+
+Numerics match ops/histogram.py's matmul path: addends cast to
+``hist_dtype`` (bf16 default), accumulation in f32 on the MXU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .histogram import HIST_CH
+
+__all__ = ["build_histograms_pallas", "pallas_available"]
+
+
+def pallas_available() -> bool:
+    """True when the Pallas TPU lowering path can run (a TPU backend)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _kernel(bins_ref, gh_ref, leaf_ref, lids_ref, out_ref, *,
+            num_bins: int, cdt, fb_pad: int, lb3_pad: int):
+    """One (feature-chunk, row-block) grid step.
+
+    bins_ref: [blk, Fc] int32 (pre-padded; out-of-range bin == no match)
+    gh_ref:   [blk, 8] f32   (grad, hess, in-bag count, 5 zero lanes)
+    leaf_ref: [blk, 8] int32 current leaf per row broadcast (-1 dead)
+    lids_ref: [8, L_pad] int32 leaf slots this build targets (-2 pad)
+    out_ref:  [fb_pad, lb3_pad] f32 accumulator (same block every row
+              step; both dims padded to MXU/VPU tile multiples)
+    """
+    j = pl.program_id(1)
+    blk, fc = bins_ref.shape
+    l_pad = lids_ref.shape[1]
+
+    bb = bins_ref[:]                                      # [blk, Fc] int32
+    iota_b = jax.lax.broadcasted_iota(
+        jnp.int32, (blk, fc, num_bins), 2)
+    onehot = (bb[:, :, None] == iota_b).astype(cdt).reshape(
+        blk, fc * num_bins)
+    if fb_pad != fc * num_bins:
+        onehot = jnp.pad(onehot, ((0, 0), (0, fb_pad - fc * num_bins)))
+
+    # leaf mask: [blk, L_pad]; pad slots are -2 and never match
+    mask = (leaf_ref[:, 0:1] == lids_ref[0:1, :]).astype(cdt)
+    ghb = gh_ref[:].astype(cdt)                           # [blk, 8]
+    ghl = (mask[:, :, None] * ghb[:, None, :HIST_CH]).reshape(
+        blk, l_pad * HIST_CH)
+    if lb3_pad != l_pad * HIST_CH:
+        ghl = jnp.pad(ghl, ((0, 0), (0, lb3_pad - l_pad * HIST_CH)))
+
+    part = jax.lax.dot_general(
+        onehot, ghl, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)               # [fb_pad, lb3_pad]
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = part
+
+    @pl.when(j > 0)
+    def _():
+        out_ref[:] = out_ref[:] + part
+
+
+try:  # pallas imports kept optional so CPU-only installs never pay for them
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _plan_chunks(F: int, B: int, L: int, vmem_budget: int = 10 << 20):
+    """Pick (row_block, feature_chunk) so onehot + out fit VMEM."""
+    lb3 = L * HIST_CH
+    # feature chunk: cap Fc*B around 4096 lanes, divisor-friendly
+    fc = max(1, min(F, 4096 // max(B, 1)))
+    while F % fc != 0:
+        fc -= 1
+    # row block: onehot blk*fc*B*2 bytes within budget
+    blk = vmem_budget // max(1, fc * B * 2 + lb3 * 4)
+    blk = int(2 ** np.floor(np.log2(max(blk, 256))))
+    blk = min(blk, 4096)
+    return blk, fc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "hist_dtype", "interpret"))
+def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
+                            row_leaf: jax.Array, leaf_ids: jax.Array, *,
+                            num_bins: int, hist_dtype: str = "bfloat16",
+                            interpret: bool = False) -> jax.Array:
+    """Pallas analog of ops.histogram.build_histograms.
+
+    Same contract: bins [R, F] uint/int, gh [R, 3] f32, row_leaf [R]
+    int32, leaf_ids [L] int32 -> [L, F, B, 3] f32. R is padded up to the
+    row block internally (padded rows get leaf -1).
+    ``interpret=True`` runs the kernel in the Pallas interpreter —
+    CPU-testable parity with the real TPU lowering.
+    """
+    if not _HAS_PALLAS:
+        raise RuntimeError("pallas unavailable in this jax build")
+    R, F = bins.shape
+    L = int(leaf_ids.shape[0])
+    B = int(num_bins)
+    cdt = jnp.dtype(hist_dtype)
+    blk, fc = _plan_chunks(F, B, L)
+
+    r_pad = ((R + blk - 1) // blk) * blk
+    if r_pad != R:
+        bins = jnp.pad(bins, ((0, r_pad - R), (0, 0)))
+        gh = jnp.pad(gh, ((0, r_pad - R), (0, 0)))
+        row_leaf = jnp.pad(row_leaf, (0, r_pad - R), constant_values=-1)
+
+    n_fb = F // fc
+    n_rb = r_pad // blk
+    # tile-aligned paddings: matmul dims to 128 lanes; the tiny metadata
+    # operands to 8 sublanes so no block has a sub-tile minor shape
+    fb_pad = -(-(fc * B) // 128) * 128
+    l_pad = max(8, -(-L // 8) * 8)
+    lb3_pad = -(-(l_pad * HIST_CH) // 128) * 128
+
+    gh8 = jnp.pad(gh, ((0, 0), (0, 8 - HIST_CH)))
+    leaf8 = jnp.broadcast_to(row_leaf[:, None].astype(jnp.int32),
+                             (r_pad, 8))
+    lids8 = jnp.broadcast_to(
+        jnp.pad(leaf_ids.astype(jnp.int32), (0, l_pad - L),
+                constant_values=-2)[None, :], (8, l_pad))
+
+    out = pl.pallas_call(
+        functools.partial(_kernel, num_bins=B, cdt=cdt, fb_pad=fb_pad,
+                          lb3_pad=lb3_pad),
+        grid=(n_fb, n_rb),
+        in_specs=[
+            pl.BlockSpec((blk, fc), lambda i, j: (j, i)),
+            pl.BlockSpec((blk, 8), lambda i, j: (j, 0)),
+            pl.BlockSpec((blk, 8), lambda i, j: (j, 0)),
+            pl.BlockSpec((8, l_pad), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((fb_pad, lb3_pad), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_fb * fb_pad, lb3_pad),
+                                       jnp.float32),
+        interpret=interpret,
+    )(bins.astype(jnp.int32), gh8, leaf8, lids8)
+
+    hist = out.reshape(n_fb, fb_pad, lb3_pad)[:, :fc * B, :l_pad * HIST_CH]
+    hist = hist.reshape(n_fb, fc, B, l_pad, HIST_CH)[:, :, :, :L, :]
+    return hist.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)

@@ -92,7 +92,7 @@ class DataParallelPlan:
                    is_cat_pf, feature_mask, *, num_leaves: int,
                    leaf_batch: int, max_depth: int, num_bins: int,
                    split_params: SplitParams, hist_dtype: str = "bfloat16",
-                   block_rows: int = 0,
+                   hist_impl: str = "auto", block_rows: int = 0,
                    valid_bins: Tuple[jax.Array, ...] = (),
                    valid_row_leaf0: Tuple[jax.Array, ...] = (),
                    mono_type_pf=None, interaction_groups=None,
@@ -102,7 +102,8 @@ class DataParallelPlan:
             is_cat_pf, feature_mask, num_leaves=num_leaves,
             leaf_batch=leaf_batch, max_depth=max_depth, num_bins=num_bins,
             split_params=split_params, axis_name=self.axis_name,
-            hist_dtype=hist_dtype, block_rows=block_rows,
+            hist_dtype=hist_dtype, hist_impl=hist_impl,
+            block_rows=block_rows,
             valid_bins=valid_bins, valid_row_leaf0=valid_row_leaf0,
             mono_type_pf=mono_type_pf,
             interaction_groups=interaction_groups, rng_key=rng_key,
@@ -112,12 +113,12 @@ class DataParallelPlan:
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
-                     "num_bins", "split_params", "axis_name", "hist_dtype",
+                     "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "n_valid", "feature_fraction_bynode"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
-                       split_params, axis_name, hist_dtype, block_rows,
+                       split_params, axis_name, hist_dtype, hist_impl, block_rows,
                        n_valid, feature_fraction_bynode):
     row = P(axis_name)
     row2 = P(axis_name, None)
@@ -132,7 +133,8 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             num_leaves=num_leaves, leaf_batch=leaf_batch,
             max_depth=max_depth, num_bins=num_bins,
             split_params=split_params, axis_name=axis_name,
-            hist_dtype=hist_dtype, block_rows=block_rows,
+            hist_dtype=hist_dtype, hist_impl=hist_impl,
+            block_rows=block_rows,
             valid_bins=vbins, valid_row_leaf0=vrl,
             mono_type_pf=mono, interaction_groups=groups, rng_key=key,
             feature_fraction_bynode=feature_fraction_bynode)
@@ -158,7 +160,8 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   is_cat_pf, feature_mask, *, num_leaves: int,
                   leaf_batch: int, max_depth: int, num_bins: int,
                   split_params: SplitParams, axis_name: str = AXIS,
-                  hist_dtype: str = "bfloat16", block_rows: int = 0,
+                  hist_dtype: str = "bfloat16", hist_impl: str = "auto",
+               block_rows: int = 0,
                   valid_bins: Tuple[jax.Array, ...] = (),
                   valid_row_leaf0: Tuple[jax.Array, ...] = (),
                   mono_type_pf=None, interaction_groups=None, rng_key=None,
@@ -176,6 +179,7 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
         feature_mask, valid_flat, extras, num_leaves=num_leaves,
         leaf_batch=leaf_batch, max_depth=max_depth, num_bins=num_bins,
         split_params=split_params, axis_name=axis_name,
-        hist_dtype=hist_dtype, block_rows=block_rows,
+        hist_dtype=hist_dtype, hist_impl=hist_impl,
+            block_rows=block_rows,
         n_valid=len(valid_bins),
         feature_fraction_bynode=feature_fraction_bynode)

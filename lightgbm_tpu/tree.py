@@ -585,6 +585,75 @@ class Tree:
             path[j][2] = path[j + 1][2]
         return path[:-1]
 
+    # ------------------------------------------------------------------
+    def to_json(self) -> Dict:
+        """Tree dict in the reference's DumpModel schema
+        (tree.cpp:411 ToJSON / NodeToJSON) — nested tree_structure with
+        split/leaf records."""
+        out = {
+            "num_leaves": int(self.num_leaves),
+            "num_cat": int(self.num_cat),
+            "shrinkage": float(self.shrinkage),
+            "tree_features": sorted(
+                {int(f) for f in self.split_feature}),
+        }
+        if self.num_leaves == 1:
+            out["tree_structure"] = {
+                "leaf_value": float(self.leaf_value[0]),
+                "leaf_count": int(self.leaf_count[0]),
+            }
+            return out
+
+        def make_node(idx: int):
+            if idx < 0:
+                s = ~idx
+                return {
+                    "leaf_index": int(s),
+                    "leaf_value": float(self.leaf_value[s]),
+                    "leaf_weight": float(self.leaf_weight[s]),
+                    "leaf_count": int(self.leaf_count[s]),
+                }
+            dt = int(self.decision_type[idx])
+            rec = {
+                "split_index": int(idx),
+                "split_feature": int(self.split_feature[idx]),
+                "split_gain": float(self.split_gain[idx]),
+            }
+            if dt & _CAT_BIT:
+                cat_idx = int(self.threshold[idx])
+                lo = self.cat_boundaries[cat_idx]
+                hi = self.cat_boundaries[cat_idx + 1]
+                cats = [c for c in range((hi - lo) * 32)
+                        if (self.cat_threshold[lo + c // 32]
+                            >> (c % 32)) & 1]
+                rec["threshold"] = "||".join(str(c) for c in cats)
+                rec["decision_type"] = "=="
+            else:
+                rec["threshold"] = float(self.threshold[idx])
+                rec["decision_type"] = "<="
+            rec["default_left"] = bool(dt & _DEFAULT_LEFT_BIT)
+            rec["missing_type"] = \
+                ("None", "Zero", "NaN", "NaN")[_missing_from_decision(dt)]
+            rec["internal_value"] = float(self.internal_value[idx])
+            rec["internal_weight"] = float(self.internal_weight[idx])
+            rec["internal_count"] = int(self.internal_count[idx])
+            return rec
+
+        # explicit-stack tree walk: leaf-wise trees can be chain-shaped
+        # (depth ~ num_leaves), far past Python's recursion limit
+        root = make_node(0)
+        stack = [(root, 0)]
+        while stack:
+            rec, idx = stack.pop()
+            for key, child in (("left_child", int(self.left_child[idx])),
+                               ("right_child", int(self.right_child[idx]))):
+                crec = make_node(child)
+                rec[key] = crec
+                if child >= 0:
+                    stack.append((crec, child))
+        out["tree_structure"] = root
+        return out
+
     def scale(self, factor: float):
         """Shrinkage(rate) (tree.h): rescale every output in place —
         DART normalization and rollback arithmetic."""

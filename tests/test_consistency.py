@@ -1,0 +1,161 @@
+"""Golden parity against the reference's shipped example configs.
+
+Analog of the reference's tests/python_package_test/test_consistency.py
+(:67-133): train from ``examples/*/train.conf`` with the conf's own params
+and datasets, and require the final metrics to land at the reference's
+levels.
+
+The golden numbers in ``golden/golden_metrics.json`` were produced by
+building the reference CLI from /root/reference (g++ direct build; empty
+submodules shimmed) and running each ``train.conf`` unmodified — see
+``golden/README.md``.  Tolerances allow for implementation differences
+(binning tie-breaks, leaf-batched growth, f32-on-device accumulation) but
+are tight enough that a broken objective/metric/split path fails.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io import parse_config_file
+
+EXAMPLES = "/root/reference/examples"
+GOLDEN = json.load(open(os.path.join(
+    os.path.dirname(__file__), "golden", "golden_metrics.json")))
+
+# params the engine does not consume from a conf file (IO/CLI plumbing)
+_DROP = {"task", "data", "valid_data", "output_model", "machine_list_file",
+         "num_machines", "local_listen_port", "is_save_binary_file",
+         "use_two_round_loading", "is_enable_sparse", "output_result",
+         "input_model"}
+
+
+def _train_from_conf(name, num_rounds=None, extra=None):
+    d = os.path.join(EXAMPLES, name)
+    conf = parse_config_file(os.path.join(d, "train.conf"))
+    data = os.path.join(d, conf["data"])
+    valid = os.path.join(d, conf["valid_data"])
+    params = {k: v for k, v in conf.items() if k not in _DROP}
+    params["verbosity"] = -1
+    if extra:
+        params.update(extra)
+    rounds = num_rounds or int(params.pop("num_trees", 100))
+    params.pop("num_trees", None)
+    train = lgb.Dataset(data, params=params)
+    vs = lgb.Dataset(valid, reference=train, params=params)
+    evals = {}
+    bst = lgb.train(params, train, num_boost_round=rounds,
+                    valid_sets=[vs], valid_names=["valid_1"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+_BIGGER_BETTER = ("auc", "ndcg", "map", "auc_mu", "average_precision")
+
+
+def _check(name, evals, tolerances):
+    """One-sided parity: match the reference within tolerance, or beat
+    it. Beating the reference is never a failure."""
+    golden = GOLDEN[name]
+    for key, (rel, abs_) in tolerances.items():
+        ds, met = key.split(":")
+        got = evals[ds][met][-1]
+        want = golden[key]
+        bigger = any(met.startswith(b) for b in _BIGGER_BETTER)
+        tol = abs_ + rel * abs(want)
+        if bigger:
+            ok = got >= want - tol - 1e-12
+        else:
+            ok = got <= want + tol + 1e-12
+        assert ok, f"{name} {key}: got {got:.6f}, reference {want:.6f}" \
+                   f" (tol {tol:.4f})"
+
+
+def test_binary_classification_conf():
+    # leaf_batch=1 grows trees exactly leaf-wise like the reference, so
+    # every metric (including train-set memorization) must land at the
+    # reference's level. Measured: train auc 0.9976 vs ref 0.9974, valid
+    # auc 0.8355 vs ref 0.8316. The batched default (leaf_batch=16)
+    # trades train-auc ~0.96 for MXU efficiency at unchanged valid auc —
+    # see test_binary_conf_leaf_batched below.
+    bst, evals = _train_from_conf("binary_classification",
+                                  extra={"leaf_batch": 1})
+    _check("binary_classification", evals, {
+        "valid_1:auc": (0.0, 0.015),
+        "valid_1:binary_logloss": (0.10, 0.0),
+        "training:auc": (0.0, 0.01),
+    })
+    # saved model round-trips through the v4 text format
+    txt = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=txt)
+    X = lgb.io.load_data_file(
+        os.path.join(EXAMPLES, "binary_classification/binary.test")).X
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X))
+
+
+def test_binary_conf_leaf_batched():
+    """Default batched growth must hold the reference's VALID metrics
+    (generalization parity) even though tree shapes differ."""
+    _, evals = _train_from_conf("binary_classification")
+    _check("binary_classification", evals, {
+        "valid_1:auc": (0.0, 0.015),
+        "valid_1:binary_logloss": (0.10, 0.0),
+    })
+
+
+def test_regression_conf():
+    _, evals = _train_from_conf("regression")
+    _check("regression", evals, {
+        "valid_1:l2": (0.12, 0.0),
+        "training:l2": (0.60, 0.0),
+    })
+
+
+def test_multiclass_conf():
+    # exact leaf-wise growth; exercises the custom auc_mu_weights matrix
+    # from the conf and the K/(K-1) softmax hessian factor. Measured:
+    # train_ll 0.704 vs ref 0.7017, valid_ll 1.228 vs ref 1.234 (beat),
+    # auc_mu 0.772 vs ref 0.753 (beat).
+    _, evals = _train_from_conf("multiclass_classification",
+                                extra={"leaf_batch": 1})
+    _check("multiclass_classification", evals, {
+        "valid_1:multi_logloss": (0.05, 0.0),
+        "valid_1:auc_mu": (0.0, 0.02),
+        "training:multi_logloss": (0.05, 0.0),
+    })
+
+
+def test_lambdarank_conf():
+    _, evals = _train_from_conf("lambdarank")
+    _check("lambdarank", evals, {
+        "valid_1:ndcg@3": (0.0, 0.035),
+        "valid_1:ndcg@5": (0.0, 0.035),
+    })
+
+
+def test_xendcg_conf():
+    _, evals = _train_from_conf("xendcg")
+    _check("xendcg", evals, {
+        "valid_1:ndcg@3": (0.0, 0.035),
+        "valid_1:ndcg@5": (0.0, 0.035),
+    })
+
+
+def test_binary_conf_hist_dtypes_agree():
+    """Settle round-1 weak item 3: bf16 histogram accumulation must not
+    cost measurable accuracy at example scale vs f32."""
+    _, ev_bf16 = _train_from_conf(
+        "binary_classification", num_rounds=40,
+        extra={"hist_dtype": "bfloat16"})
+    _, ev_f32 = _train_from_conf(
+        "binary_classification", num_rounds=40,
+        extra={"hist_dtype": "float32"})
+    auc_bf16 = ev_bf16["valid_1"]["auc"][-1]
+    auc_f32 = ev_f32["valid_1"]["auc"][-1]
+    # different rounding -> different trees after 40 rounds; what must
+    # hold is that bf16 costs no systematic accuracy (either can win the
+    # coin-flip by a couple of ndcg points of auc)
+    assert abs(auc_bf16 - auc_f32) < 0.02, (auc_bf16, auc_f32)
