@@ -1,0 +1,4 @@
+"""`python -m lightgbm_tpu` — the CLI front end (src/main.cpp analog)."""
+from .cli import main
+
+raise SystemExit(main())

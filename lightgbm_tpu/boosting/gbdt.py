@@ -106,17 +106,37 @@ class GBDT:
         self.block = block_rows_for(self.train_set.num_data, F, self.B)
         # data-parallel over every local device (tree_learner param,
         # tree_learner.cpp:15 factory analog; "serial" pins one device)
+        if bool(config.linear_tree):
+            for ds_ in (self.train_set, *[v.construct()
+                                          for v in valid_sets]):
+                if getattr(ds_, "raw_values", None) is None:
+                    raise ValueError(
+                        "linear_tree needs raw feature values for every "
+                        "dataset; binary dataset caches do not retain "
+                        "them — construct Datasets from arrays or text "
+                        "files")
+        if int(config.num_machines) > 1:
+            # multi-host bootstrap (Network::Init analog): after this,
+            # jax.devices() spans every host and the mesh plans below
+            # cover DCN transparently
+            from ..parallel.distributed import maybe_init_distributed
+            maybe_init_distributed(config)
         n_dev = len(jax.devices())
         self.plan = None
         if n_dev > 1 and config.tree_learner != "serial":
-            from ..parallel.data_parallel import DataParallelPlan
-            self.plan = DataParallelPlan()
-            # keep the scan block well under the per-shard row count so
-            # shard-granular padding stays a small fraction of the data
-            per_shard = -(-self.train_set.num_data // n_dev)
-            cap = max(256, 1 << int(np.floor(np.log2(
-                max(1, per_shard // 4)))))
-            self.block = min(self.block, cap)
+            from ..parallel.data_parallel import (
+                DataParallelPlan, FeatureParallelPlan, VotingParallelPlan)
+            plan_cls = {"feature": FeatureParallelPlan,
+                        "voting": VotingParallelPlan}.get(
+                            config.tree_learner, DataParallelPlan)
+            self.plan = plan_cls(top_k=int(config.top_k))
+            if self.plan.rows_sharded:
+                # keep the scan block well under the per-shard row count
+                # so shard-granular padding stays a small fraction
+                per_shard = -(-self.train_set.num_data // n_dev)
+                cap = max(256, 1 << int(np.floor(np.log2(
+                    max(1, per_shard // 4)))))
+                self.block = min(self.block, cap)
         self.train_dd = _DeviceData(self.train_set, self.block, self.plan)
         self.valid_dd = [
             _DeviceData(v.construct(), self.block, self.plan)
@@ -234,6 +254,21 @@ class GBDT:
 
         self._update_score_jit = jax.jit(self._update_score_impl)
         self._goss_jit = jax.jit(self._goss_impl)
+
+        # quantized-gradient training (GradientDiscretizer,
+        # gradient_discretizer.hpp:22/.cpp:55-140): gradients are
+        # stochastically rounded onto a {k*scale} grid. TPU-first
+        # realization: quantize-DEQUANTIZE — grid values flow through the
+        # same MXU histogram kernels and accumulate exactly in f32, so no
+        # separate int16/int32 histogram code path is needed; the
+        # information loss (and its regularization effect) matches the
+        # reference's int8 pipeline.
+        self._quant = bool(config.use_quantized_grad)
+        if self._quant:
+            self._quant_key = jax.random.PRNGKey(
+                (int(config.data_random_seed) * 65537 + 17) & 0x7FFFFFFF)
+            self._quantize_jit = jax.jit(self._quantize_impl)
+            self._renew_jit = jax.jit(self._renew_leaf_impl)
 
     # ------------------------------------------------------------------
     def _field_init_scores(self, init, n: int, r_pad: int) -> np.ndarray:
@@ -443,6 +478,136 @@ class GBDT:
             interaction_groups=self.interaction_groups,
             rng_key=key, feature_fraction_bynode=self._ffbn)
 
+    def _quantize_impl(self, g, h, key):
+        """Stochastic rounding onto the quant grid (DiscretizeGradients,
+        gradient_discretizer.cpp:68-140). g, h: [K, R]."""
+        cfg = self.config
+        nb = int(cfg.num_grad_quant_bins)
+        gs = jnp.maximum(jnp.max(jnp.abs(g), axis=1, keepdims=True),
+                         1e-30) / (nb // 2)
+        hs = jnp.maximum(jnp.max(jnp.abs(h), axis=1, keepdims=True),
+                         1e-30) / nb
+        if bool(cfg.stochastic_rounding):
+            u1 = jax.random.uniform(jax.random.fold_in(key, 0), g.shape)
+            u2 = jax.random.uniform(jax.random.fold_in(key, 1), h.shape)
+        else:
+            u1 = jnp.full_like(g, 0.5)
+            u2 = jnp.full_like(h, 0.5)
+        # int8 cast truncates toward zero; the random offset is applied
+        # away from zero (gradient_discretizer.cpp:124-131)
+        qg = jnp.trunc(g / gs + jnp.where(g >= 0, u1, -u1))
+        qh = jnp.trunc(h / hs + u2)
+        return qg * gs, qh * hs
+
+    def _renew_leaf_impl(self, tree_arrays: TreeArrays, row_leaf, g, h):
+        """RenewIntGradTreeOutput (gradient_discretizer.cpp:208-258):
+        after a quantized build, leaf outputs are recomputed from the
+        TRUE float grad/hess sums per leaf."""
+        from ..ops.split import calc_output
+        sp = self.split_params
+        L1 = tree_arrays.leaf_values.shape[0]      # L + 1 (dummy slot)
+        rlc = jnp.clip(row_leaf, 0, L1 - 1)
+        dead = row_leaf < 0
+        gz = jnp.where(dead, 0.0, g)
+        hz = jnp.where(dead, 0.0, h)
+        sum_g = jnp.zeros((L1,), jnp.float32).at[rlc].add(gz)
+        sum_h = jnp.zeros((L1,), jnp.float32).at[rlc].add(hz)
+        cnt = jnp.zeros((L1,), jnp.float32).at[rlc].add(
+            jnp.where(dead, 0.0, 1.0))
+        # NOTE: no path smoothing here — the reference's renewal calls
+        # CalculateSplittedLeafOutput<USE_L1=true, USE_MAX_OUTPUT=true,
+        # USE_SMOOTHING=false> (gradient_discretizer.cpp:231,254)
+        out = calc_output(sum_g, sum_h, sp.lambda_l1, sp.lambda_l2,
+                          sp.max_delta_step)
+        live = (jnp.arange(L1) < tree_arrays.num_leaves) & (sum_h > 0)
+        new_leaf = jnp.where(live, out, tree_arrays.leaf_values)
+        node_value = tree_arrays.node_value.at[tree_arrays.leaf2node].set(
+            jnp.where(live, new_leaf, jnp.take(
+                tree_arrays.node_value, tree_arrays.leaf2node)))
+        return tree_arrays._replace(leaf_values=new_leaf,
+                                    node_value=node_value)
+
+    # ------------------------------------------------------------------
+    def _fit_linear_leaves(self, tree, row_leaf, g, h, shrink: float):
+        """Per-leaf ridge solve on raw feature values
+        (LinearTreeLearner::CalculateLinear, linear_tree_learner.cpp:
+        280-385): for each leaf, regress -g on the raw values of the
+        features along its path, weighted by h, ridge linear_lambda.
+        Host NumPy: the solves are tiny ((d+1)^2 per leaf); the heavy
+        segment sums vectorize over rows per leaf."""
+        raw = self.train_set.raw_values
+        lam = float(self.config.linear_lambda)
+        n = self.train_set.num_data
+        rl = np.asarray(row_leaf)[:n]
+        g = np.asarray(g)[:n].astype(np.float64)
+        h = np.asarray(h)[:n].astype(np.float64)
+
+        # path features per leaf (global ids, first-use order)
+        paths = [[] for _ in range(tree.num_leaves)]
+        if tree.num_leaves > 1:
+            stack = [(0, [])]
+            while stack:
+                node, feats = stack.pop()
+                if node < 0:
+                    paths[~node] = feats
+                    continue
+                f = int(tree.split_feature[node])
+                nf = feats if f in feats else feats + [f]
+                stack.append((int(tree.left_child[node]), nf))
+                stack.append((int(tree.right_child[node]), nf))
+
+        tree.is_linear = True
+        for s in range(tree.num_leaves):
+            feats = paths[s]
+            rows = np.nonzero(rl == s)[0]
+            tree.leaf_features[s] = []
+            tree.leaf_coeff[s] = []
+            tree.leaf_const[s] = tree.leaf_value[s]
+            if not feats or len(rows) == 0:
+                continue
+            vals = raw[np.ix_(rows, feats)].astype(np.float64)
+            ok = ~np.isnan(vals).any(axis=1)
+            if ok.sum() < len(feats) + 1:
+                continue  # too few clean rows: constant leaf
+            X = np.concatenate([vals[ok], np.ones((ok.sum(), 1))], axis=1)
+            hw = h[rows][ok]
+            gw = g[rows][ok]
+            A = (X * hw[:, None]).T @ X
+            d = len(feats)
+            A[np.arange(d), np.arange(d)] += lam
+            b = X.T @ gw
+            try:
+                beta = -np.linalg.solve(A, b)
+            except np.linalg.LinAlgError:
+                continue
+            if not np.isfinite(beta).all():
+                continue
+            keep = np.abs(beta[:d]) > 1e-35   # kZeroThreshold
+            tree.leaf_features[s] = [feats[i] for i in range(d) if keep[i]]
+            tree.leaf_coeff[s] = [float(beta[i] * shrink)
+                                  for i in range(d) if keep[i]]
+            tree.leaf_const[s] = float(beta[d] * shrink)
+
+    def _linear_score_delta(self, tree, raw, row_leaf, r_pad):
+        """Per-row SHRUNK outputs of a linear tree (AddPredictionToScore
+        linear path, tree.cpp:120-149) for the score update."""
+        n = raw.shape[0]
+        rl = np.asarray(row_leaf)[:n]
+        out = np.zeros(r_pad, np.float32)
+        for s in range(tree.num_leaves):
+            rows = np.nonzero(rl == s)[0]
+            if len(rows) == 0:
+                continue
+            feats = tree.leaf_features[s]
+            if not feats:
+                out[rows] = tree.leaf_const[s]
+                continue
+            vals = raw[np.ix_(rows, feats)].astype(np.float64)
+            nan = np.isnan(vals).any(axis=1)
+            lin = tree.leaf_const[s] + vals @ np.asarray(tree.leaf_coeff[s])
+            out[rows] = np.where(nan, tree.leaf_value[s], lin)
+        return out
+
     def _bias_adjust_device(self, tree_arrays: TreeArrays, bias: float,
                             shrink: float) -> TreeArrays:
         """Fold an output bias into the stored device tree so that
@@ -462,32 +627,63 @@ class GBDT:
         else:
             g, h = self._prep_custom_gh(gradients, hessians)
         g, h, count_mask = self._sampling(self.iter_, g, h)
+        g_true, h_true = g, h
+        if self._quant:
+            g, h = self._quantize_jit(
+                g, h, jax.random.fold_in(self._quant_key, self.iter_))
 
         fmask = self._feature_mask()
+        linear = bool(self.config.linear_tree)
         should_continue = False
         for k in range(self.K):
             gh = jnp.stack([g[k], h[k], count_mask], axis=1)
             tree_arrays, row_leaf, valid_rls = self._build_one_tree(gh, fmask, k)
+            if self._quant and bool(self.config.quant_train_renew_leaf):
+                tree_arrays = self._renew_jit(tree_arrays, row_leaf,
+                                              g_true[k], h_true[k])
             host = jax.tree.map(np.asarray, tree_arrays)
             num_leaves_trained = int(host.num_leaves)
             shrink = self.shrinkage
-            if num_leaves_trained > 1:
-                should_continue = True
-                lr = jnp.asarray(shrink, jnp.float32)
-                self.scores = self.scores.at[k].set(self._update_score_jit(
-                    self.scores[k], tree_arrays.leaf_values, row_leaf, lr))
-                for vi, vrl in enumerate(valid_rls):
-                    self.valid_scores[vi] = self.valid_scores[vi].at[k].set(
-                        self._update_score_jit(
-                            self.valid_scores[vi][k],
-                            tree_arrays.leaf_values, vrl, lr))
             tree = Tree.from_device(host, self.train_set.bin_mappers,
                                     self.train_set.used_features, shrink)
+            if linear and num_leaves_trained > 1:
+                self._fit_linear_leaves(tree, row_leaf, g_true[k],
+                                        h_true[k], shrink)
+            if num_leaves_trained > 1:
+                should_continue = True
+                if linear:
+                    # linear outputs live on host (raw feature values);
+                    # scores updated from the per-row linear deltas
+                    delta = self._linear_score_delta(
+                        tree, self.train_set.raw_values, row_leaf,
+                        self.train_dd.r_pad)
+                    self.scores = self.scores.at[k].add(jnp.asarray(delta))
+                    for vi, vrl in enumerate(valid_rls):
+                        vds = self.valid_sets[vi]
+                        vdelta = self._linear_score_delta(
+                            tree, vds.raw_values, vrl,
+                            self.valid_dd[vi].r_pad)
+                        self.valid_scores[vi] = self.valid_scores[vi] \
+                            .at[k].add(jnp.asarray(vdelta))
+                else:
+                    lr = jnp.asarray(shrink, jnp.float32)
+                    self.scores = self.scores.at[k].set(
+                        self._update_score_jit(
+                            self.scores[k], tree_arrays.leaf_values,
+                            row_leaf, lr))
+                    for vi, vrl in enumerate(valid_rls):
+                        self.valid_scores[vi] = \
+                            self.valid_scores[vi].at[k].set(
+                                self._update_score_jit(
+                                    self.valid_scores[vi][k],
+                                    tree_arrays.leaf_values, vrl, lr))
             bias = self._init_scores[k]
             if self.iter_ == 0 and abs(bias) > kEpsilon:
                 # AddBias (gbdt.cpp:416): fold init score into first tree
                 tree.leaf_value += bias
                 tree.internal_value += bias
+                if tree.is_linear:  # AddBias touches leaf_const too
+                    tree.leaf_const += bias
                 # scores already start at the init score; only the STORED
                 # device tree carries the bias so later per-tree score
                 # arithmetic (DART drop, rollback, refit) stays consistent
@@ -529,13 +725,26 @@ class GBDT:
         nan_bins = np.asarray(self.nan_bin_pf)
         bins_h = np.asarray(self.train_dd.bins)
         vbins_h = [np.asarray(dd.bins) for dd in self.valid_dd]
+
+        def row_outputs(tree, binned, raw, r_pad):
+            # linear trees carry per-row outputs that the binned replay
+            # cannot reproduce — replay them from raw feature values
+            if tree.is_linear:
+                out = np.zeros(r_pad, np.float32)
+                out[:raw.shape[0]] = tree.predict(raw)
+                return out
+            return tree.predict_binned(binned, uf, nan_bins)
+
         for k in range(self.K):
             tree = self.models[-(self.K - k)]
-            pred = tree.predict_binned(bins_h, uf, nan_bins)
+            pred = row_outputs(tree, bins_h, self.train_set.raw_values,
+                               self.train_dd.r_pad)
             self.scores = self.scores.at[k].add(
                 -jnp.asarray(pred, jnp.float32))
             for vi, vb in enumerate(vbins_h):
-                vpred = tree.predict_binned(vb, uf, nan_bins)
+                vpred = row_outputs(tree, vb,
+                                    self.valid_sets[vi].raw_values,
+                                    self.valid_dd[vi].r_pad)
                 self.valid_scores[vi] = self.valid_scores[vi].at[k].add(
                     -jnp.asarray(vpred, jnp.float32))
         for _ in range(self.K):

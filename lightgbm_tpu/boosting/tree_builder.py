@@ -106,8 +106,9 @@ def _round_int(x):
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
-                     "split_params", "axis_name", "hist_dtype", "hist_impl", "block_rows",
-                     "feature_fraction_bynode"))
+                     "split_params", "axis_name", "hist_dtype", "hist_impl",
+                     "block_rows", "feature_fraction_bynode",
+                     "parallel_mode", "top_k"))
 def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
@@ -122,8 +123,33 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                interaction_groups: Optional[jax.Array] = None,
                rng_key: Optional[jax.Array] = None,
                feature_fraction_bynode: float = 1.0,
-               cat_sorted_mask: Optional[jax.Array] = None):
-    """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs)."""
+               cat_sorted_mask: Optional[jax.Array] = None,
+               parallel_mode: str = "data", top_k: int = 20,
+               local_bins: Optional[jax.Array] = None,
+               local_meta: Optional[Tuple] = None,
+               feat_offset: Optional[jax.Array] = None):
+    """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
+
+    ``parallel_mode`` (with ``axis_name`` set) selects the distributed
+    strategy, mirroring tree_learner=data/feature/voting
+    (tree_learner.cpp:15 factory):
+    - "data": rows sharded; the histogram psum IS the ReduceScatter
+      merge; split selection replicated (no winner sync needed).
+    - "feature": rows replicated, split WORK feature-sharded
+      (feature_parallel_tree_learner.cpp:38-77): each chip histograms
+      only its ``local_bins`` [R, F_loc] slice (``local_meta`` = that
+      slice's (num_bins_pf, nan_bin_pf, is_cat_pf, feature_mask,
+      mono_type_pf-or-None); ``feat_offset`` = global id of local
+      feature 0), then the winner is merged by gain-argmax across chips
+      — SyncUpGlobalBestSplit (parallel_tree_learner.h:209) as a
+      pmax/pmin pair + masked psum payload broadcast.
+    - "voting": rows sharded, PV-Tree
+      (voting_parallel_tree_learner.cpp:16-120): local histograms only;
+      each chip votes its per-leaf top-``top_k`` features by local gain;
+      votes are psum-merged; the global top-2k elected features' columns
+      are gathered and psum'd (communication O(top_k·B), not O(F·B));
+      the split is chosen from those global sub-histograms.
+    """
     R, F = bins.shape
     L = num_leaves
     W = max(1, min(leaf_batch, L - 1))
@@ -142,10 +168,63 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     if (use_bynode or use_rand) and rng_key is None:
         raise ValueError("feature_fraction_bynode/extra_trees need rng_key")
 
+    mode = parallel_mode if axis_name is not None else "data"
+    if mode == "feature":
+        if local_bins is None or local_meta is None or feat_offset is None:
+            raise ValueError(
+                "feature-parallel needs local_bins/local_meta/feat_offset")
+        if use_inter or use_bynode or use_rand:
+            raise NotImplementedError(
+                "tree_learner=feature does not yet compose with "
+                "interaction constraints / per-node sampling / extra_trees")
+        if cat_sorted_mask is not None:
+            raise NotImplementedError(
+                "tree_learner=feature with sorted-subset categoricals is "
+                "not supported; set max_cat_to_onehot high enough")
+        (loc_nbpf, loc_nanpf, loc_catpf, loc_fmask, loc_mono) = local_meta
+    if mode == "voting" and cat_sorted_mask is not None:
+        raise NotImplementedError(
+            "tree_learner=voting with sorted-subset categoricals is not "
+            "supported; set max_cat_to_onehot high enough")
+
     def hist_for(slots, rl):
+        if mode == "feature":
+            # local feature slice, all rows on-chip: no collective here
+            return build_histograms(
+                local_bins, gh, rl, slots, num_bins=B,
+                block_rows=block_rows, axis_name=axis_name, merge=False,
+                hist_dtype=hist_dtype, impl=hist_impl)
+        if mode == "voting":
+            # local rows only; the merge happens per elected feature
+            return build_histograms(
+                bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
+                axis_name=axis_name, merge=False,
+                hist_dtype=hist_dtype, impl=hist_impl)
         return build_histograms(
             bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
             axis_name=axis_name, hist_dtype=hist_dtype, impl=hist_impl)
+
+    def _sync_best(bs):
+        """Merge per-shard best splits by gain (SyncUpGlobalBestSplit)."""
+        gain = bs["gain"]
+        gmax = jax.lax.pmax(gain, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        big = jnp.int32(1 << 30)
+        mine = jnp.where((gain == gmax) & jnp.isfinite(gain), idx, big)
+        win = jax.lax.pmin(mine, axis_name)
+        is_win = idx == win
+        def pick(v):
+            m = is_win
+            while m.ndim < v.ndim:
+                m = m[..., None]
+            if v.dtype == jnp.bool_:
+                z = jnp.where(m, v, False).astype(jnp.int32)
+                return jax.lax.psum(z, axis_name) > 0
+            z = jnp.where(m, v, jnp.zeros_like(v))
+            return jax.lax.psum(z, axis_name)
+        out = {k: pick(v) for k, v in bs.items() if k != "gain"}
+        out["gain"] = gmax
+        return out
 
     nnb_pf = num_bins_pf - (nan_bin_pf >= 0).astype(jnp.int32)
 
@@ -190,17 +269,70 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         parent_out = jnp.take(t.node_value, node_of)
         fmask_s, rand_bin = slot_masks_and_bins(
             state.get("used_feat"), slots_c, key)
-        bs = find_best_splits(
-            hist2w, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
-            feature_mask=fmask_s, mono_type=mono_type_pf,
-            leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
-            slot_depth=slot_depth, rand_bin=rand_bin,
-            cat_sorted_mask=cat_sorted_mask)
+        if mode == "feature":
+            # split search over this chip's feature slice only
+            bs = find_best_splits(
+                hist2w, loc_nbpf, loc_nanpf, loc_catpf, sp,
+                feature_mask=loc_fmask, mono_type=loc_mono,
+                leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
+                slot_depth=slot_depth)
+            bs["feature"] = bs["feature"] + feat_offset
+        elif mode == "voting":
+            S = slots_c.shape[0]
+            # 1. local candidate gains per (slot, feature)
+            bs_loc = find_best_splits(
+                hist2w, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
+                feature_mask=fmask_s, mono_type=mono_type_pf,
+                leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
+                slot_depth=slot_depth, rand_bin=rand_bin,
+                return_feature_gain=True)
+            fg = bs_loc["feature_gain"]                       # [S, F]
+            k = min(top_k, F)
+            k2 = min(2 * top_k, F)
+            topg, topi = jax.lax.top_k(fg, k)
+            # 2. vote: one ballot per locally-viable top-k feature
+            votes = jnp.zeros((S, F), f32).at[
+                jnp.arange(S)[:, None], topi].add(
+                    (topg > NEG_INF).astype(f32))
+            votes = jax.lax.psum(votes, axis_name)
+            # 3. elect global top-2k (ties -> lower feature id)
+            score = votes * (F + 1.0) - jnp.arange(F, dtype=f32)[None, :]
+            _, elected = jax.lax.top_k(score, k2)             # [S, k2]
+            # 4. merge ONLY the elected columns across chips
+            sub_hist = jax.lax.psum(
+                jnp.take_along_axis(
+                    hist2w, elected[:, :, None, None], axis=1), axis_name)
+            sub_fmask = (jnp.take_along_axis(fmask_s, elected, axis=1)
+                         if fmask_s.ndim == 2
+                         else jnp.take(fmask_s, elected))
+            bs = find_best_splits(
+                sub_hist, jnp.take(num_bins_pf, elected),
+                jnp.take(nan_bin_pf, elected),
+                jnp.take(is_cat_pf, elected), sp,
+                feature_mask=sub_fmask,
+                mono_type=(jnp.take(mono_type_pf, elected)
+                           if use_mono else None),
+                leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
+                slot_depth=slot_depth,
+                rand_bin=(jnp.take_along_axis(rand_bin, elected, axis=1)
+                          if rand_bin is not None else None))
+            bs["feature"] = jnp.take_along_axis(
+                elected, bs["feature"][:, None], axis=1)[:, 0] \
+                .astype(jnp.int32)
+        else:
+            bs = find_best_splits(
+                hist2w, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
+                feature_mask=fmask_s, mono_type=mono_type_pf,
+                leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
+                slot_depth=slot_depth, rand_bin=rand_bin,
+                cat_sorted_mask=cat_sorted_mask)
         g = bs["gain"]
         if max_depth > 0:
             g = jnp.where(slot_depth < max_depth, g, NEG_INF)
         g = jnp.where(slot_valid, g, NEG_INF)
         bs["gain"] = g
+        if mode == "feature":
+            bs = _sync_best(bs)
         return bs
 
     # ---------------- state ----------------
@@ -248,6 +380,10 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     root_slots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(0)
     hist0 = hist_for(root_slots, row_leaf0)
     root_sums = hist0[0, 0, :, :].sum(axis=0)       # all rows land in f0 bins
+    if mode == "voting":
+        # local hist -> global root sums (the Allreduce of root
+        # (count, sum_g, sum_h), data_parallel_tree_learner.cpp:160-219)
+        root_sums = jax.lax.psum(root_sums, axis_name)
     root_val = leaf_output(root_sums[0], root_sums[1], sp.lambda_l1,
                            sp.lambda_l2, sp.max_delta_step)
     tree = tree._replace(

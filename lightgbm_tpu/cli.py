@@ -1,0 +1,160 @@
+"""Config-file command-line front end.
+
+Analog of the reference CLI (``src/main.cpp`` + ``src/application/
+application.cpp:209-281``): ``python -m lightgbm_tpu config=train.conf
+[key=value ...]`` dispatches on ``task`` — train, predict, refit,
+save_binary, convert_model — so the reference's shipped example configs
+run unmodified.
+
+Parameter precedence matches Application::LoadParameters
+(application.cpp:31-86): command-line pairs beat config-file pairs;
+within each source the first occurrence wins (KeepFirstValues).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config
+from .io import parse_config_file
+
+__all__ = ["main", "run"]
+
+# IO/driver keys the training engine does not consume (output_model and
+# snapshot_freq stay: engine.train writes periodic checkpoints)
+_ENGINE_DROP = {
+    "task", "data", "valid", "input_model", "output_result",
+    "machine_list_filename", "local_listen_port", "save_binary",
+    "two_round", "is_enable_sparse", "enable_bundle", "convert_model",
+    "convert_model_language",
+}
+
+
+def _parse_argv(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for tok in argv:
+        if "=" not in tok:
+            raise SystemExit(f"unrecognized argument (want key=value): "
+                             f"{tok!r}")
+        k, v = tok.split("=", 1)
+        params.setdefault(k.strip(), v.strip())
+    conf = params.pop("config", params.pop("config_file", None))
+    if conf:
+        base_dir = os.path.dirname(os.path.abspath(conf))
+        for k, v in parse_config_file(conf).items():
+            params.setdefault(k, v)
+        params["_conf_dir"] = base_dir
+    return params
+
+
+def _resolve_path(path: str, conf_dir: Optional[str]) -> str:
+    if os.path.isabs(path) or os.path.exists(path) or not conf_dir:
+        return path
+    cand = os.path.join(conf_dir, path)
+    return cand if os.path.exists(cand) else path
+
+
+def run(params: Dict[str, str]) -> int:
+    import lightgbm_tpu as lgb
+
+    conf_dir = params.pop("_conf_dir", None)
+    cfg = Config({k: v for k, v in params.items()
+                  if k not in ("valid",)})  # valid handled as list below
+    task = (params.get("task") or "train").strip()
+    engine_params = {k: v for k, v in params.items()
+                     if Config.canonical_name(k) not in _ENGINE_DROP}
+
+    if task in ("train", "refit"):
+        data_path = _resolve_path(cfg.data, conf_dir)
+        if not data_path:
+            raise SystemExit("task=train needs data=<file>")
+        train = lgb.Dataset(data_path, params=engine_params)
+        if task == "refit":
+            model_in = _resolve_path(cfg.input_model, conf_dir)
+            base = lgb.Booster(model_file=model_in)
+            train.construct()
+            booster = base.refit(train._raw_data
+                                 if train._raw_data is not None
+                                 else data_path, train.label)
+            booster.save_model(cfg.output_model)
+            print(f"Finished refit; model written to {cfg.output_model}")
+            return 0
+        valid_sets, valid_names = [], []
+        # any alias of `valid` names the validation files (config.py
+        # registers test/test_data/valid_data/valid_data_file/...)
+        vspec = next(
+            (v for k, v in params.items()
+             if Config.canonical_name(k) == "valid" and v), "")
+        for i, v in enumerate(str(vspec).split(",")):
+            v = v.strip()
+            if not v:
+                continue
+            valid_sets.append(lgb.Dataset(_resolve_path(v, conf_dir),
+                                          reference=train,
+                                          params=engine_params))
+            valid_names.append(f"valid_{i + 1}")
+        if bool(cfg.save_binary):
+            train.construct().save_binary(data_path + ".bin")
+        callbacks = []
+        if int(cfg.metric_freq) > 0 and int(cfg.verbosity) >= 0:
+            callbacks.append(lgb.log_evaluation(int(cfg.metric_freq)))
+        booster = lgb.train(
+            engine_params, train, num_boost_round=int(cfg.num_iterations),
+            valid_sets=valid_sets, valid_names=valid_names,
+            callbacks=callbacks)
+        booster.save_model(cfg.output_model)
+        print(f"Finished training; model written to {cfg.output_model}")
+        return 0
+
+    if task == "predict":
+        model_in = _resolve_path(cfg.input_model, conf_dir)
+        data_path = _resolve_path(cfg.data, conf_dir)
+        booster = lgb.Booster(model_file=model_in)
+        pred = booster.predict(
+            data_path, raw_score=bool(cfg.predict_raw_score),
+            pred_leaf=bool(cfg.predict_leaf_index),
+            pred_contrib=bool(cfg.predict_contrib))
+        out = np.asarray(pred)
+        with open(cfg.output_result, "w") as f:
+            if out.ndim == 1:
+                for v in out:
+                    f.write(f"{v:.18g}\n")
+            else:
+                for row in out:
+                    f.write("\t".join(f"{v:.18g}" for v in row) + "\n")
+        print(f"Finished prediction; results written to "
+              f"{cfg.output_result}")
+        return 0
+
+    if task == "save_binary":
+        data_path = _resolve_path(cfg.data, conf_dir)
+        ds = lgb.Dataset(data_path, params=dict(
+            engine_params, _allow_no_label=True))
+        ds.construct().save_binary(data_path + ".bin")
+        print(f"Binary dataset written to {data_path}.bin")
+        return 0
+
+    if task == "convert_model":
+        raise SystemExit(
+            "task=convert_model (if-else code generation, "
+            "gbdt_model_text.cpp:286) is not implemented; use "
+            "Booster.dump_model() for a JSON export")
+
+    raise SystemExit(f"unknown task: {task!r}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
+              "tasks: train | predict | refit | save_binary")
+        return 0
+    return run(_parse_argv(argv))
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

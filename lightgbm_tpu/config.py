@@ -244,6 +244,9 @@ PARAMS: Dict[str, ParamSpec] = {
                                        "test_data", "test_data_file",
                                        "valid_filenames")),
         _p("input_model", "", str, aliases=("model_input", "model_in")),
+        _p("convert_model", "gbdt_prediction.cpp", str,
+           aliases=("convert_model_file",)),
+        _p("convert_model_language", "", str),
         _p("output_model", "LightGBM_model.txt", str,
            aliases=("model_output", "model_out")),
         _p("saved_feature_importance_type", 0, int),
@@ -356,6 +359,11 @@ class Config:
         self._apply_special_rules()
         self.check_param_conflict()
 
+    @staticmethod
+    def canonical_name(key: str) -> str:
+        """Alias -> canonical param name (KeyAliasTransform analog)."""
+        return ALIASES.get(key, key)
+
     def _apply_special_rules(self):
         v = self._values
         obj = v.get("objective")
@@ -383,6 +391,21 @@ class Config:
                 raise ValueError(
                     "rf boosting requires bagging (bagging_freq > 0 and "
                     "0 < bagging_fraction < 1) or feature_fraction < 1")
+        if self.linear_tree:
+            # config.cpp:429-444 linear tree restrictions
+            if self.zero_as_missing:
+                raise ValueError(
+                    "zero_as_missing must be false when fitting linear "
+                    "trees")
+            if self.objective == "regression_l1":
+                raise ValueError(
+                    "Cannot use regression_l1 objective when fitting "
+                    "linear trees")
+            if v.get("boosting") == "dart":
+                # DART's drop/restore replays constant leaf values; the
+                # linear per-row outputs would corrupt running scores
+                raise ValueError(
+                    "linear_tree is not supported with boosting=dart")
         if self.objective in ("multiclass", "multiclassova") \
                 and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objective")

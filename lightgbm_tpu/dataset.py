@@ -68,6 +68,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
 
         self.bin_mappers: List[BinMapper] = []
+        self.raw_values: Optional[np.ndarray] = None  # kept for linear_tree
         self.bins: Optional[np.ndarray] = None      # [num_data, F] int
         self.num_data: int = 0
         self.num_total_features: int = 0
@@ -79,6 +80,9 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._constructed:
             return self
+        # params may have been merged from the Booster since __init__
+        # (reference _update_params flow, basic.py) — refresh the config
+        self.config = Config(self.params)
         if self.reference is not None:
             # a valid set needs its train set's bin mappers (and, for
             # LibSVM, its width) before anything else happens
@@ -184,6 +188,14 @@ class Dataset:
 
         if self.label is None and not self.params.get("_allow_no_label"):
             raise ValueError("Dataset has no label")
+        # linear trees regress on raw feature values; keep them resident
+        # (the reference keeps raw data when linear_tree, dataset.cpp)
+        self.raw_values = None
+        ref_cfg = (self.reference.config if self.reference is not None
+                   else None)
+        if self.config.linear_tree or (
+                ref_cfg is not None and ref_cfg.linear_tree):
+            self.raw_values = np.ascontiguousarray(data, np.float32)
         if self.free_raw_data:
             self._raw_data = None
         self._constructed = True

@@ -68,12 +68,12 @@ def _pvary(x, axis_name):
 @functools.partial(
     jax.jit,
     static_argnames=("num_bins", "block_rows", "axis_name", "hist_dtype",
-                     "impl"))
+                     "impl", "merge"))
 def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                      leaf_ids: jax.Array, *, num_bins: int,
                      block_rows: int = 0, axis_name: Optional[str] = None,
                      hist_dtype: str = "bfloat16",
-                     impl: str = "auto") -> jax.Array:
+                     impl: str = "auto", merge: bool = True) -> jax.Array:
     """Accumulate per-(leaf, feature, bin) sums of (grad, hess, count).
 
     Args:
@@ -87,7 +87,9 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
       axis_name: if inside shard_map over a row-sharded mesh axis, the
         mapped axis name; histograms are psum-merged over it — the analog of
         the reference's ReduceScatter+Allgather histogram merge
-        (data_parallel_tree_learner.cpp:284).
+        (data_parallel_tree_learner.cpp:284). With ``merge=False`` the
+        result stays shard-LOCAL (feature/voting-parallel modes merge
+        selectively later) but scan carries are still marked varying.
       impl: "matmul" (MXU one-hot formulation), "scatter" (XLA scatter-add
         — the dense_bin.hpp:105 shape, fast on CPU where XLA lowers it to
         per-row adds, pathological on TPU), or "auto" (backend default:
@@ -153,7 +155,7 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
             acc0 = _pvary(acc0, axis_name)
         acc, _ = jax.lax.scan(body_scatter, acc0, (bins_b, gh_b, leaf_b))
         hist = acc[:L * F * B].reshape(L, F, B, HIST_CH)
-        if axis_name is not None:
+        if axis_name is not None and merge:
             hist = jax.lax.psum(hist, axis_name)
         return hist
 
@@ -179,7 +181,7 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         acc0 = _pvary(acc0, axis_name)
     acc, _ = jax.lax.scan(body, acc0, (bins_b, gh_b, leaf_b))
     hist = acc.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
-    if axis_name is not None:
+    if axis_name is not None and merge:
         # cross-chip merge over ICI — replaces Network::ReduceScatter +
         # best-split Allgather of the reference data-parallel learner.
         hist = jax.lax.psum(hist, axis_name)

@@ -128,20 +128,24 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
                      parent_output: Optional[jax.Array] = None,
                      slot_depth: Optional[jax.Array] = None,
                      rand_bin: Optional[jax.Array] = None,
-                     cat_sorted_mask: Optional[jax.Array] = None
+                     cat_sorted_mask: Optional[jax.Array] = None,
+                     return_feature_gain: bool = False
                      ) -> Dict[str, jax.Array]:
     """Vectorized best split per leaf.
 
     Args:
       hist: [L, F, B, 3] (sum_grad, sum_hess, count) per (leaf, feature, bin).
-      num_bins_per_feat: [F] int32 — valid bins per feature (<= B).
-      nan_bin: [F] int32 — NaN bin index per feature, -1 if none.
-      is_cat: [F] bool — categorical feature flags.
+      num_bins_per_feat: [F] or [L, F] int32 — valid bins per feature
+        (<= B). All per-feature metadata below likewise accepts a
+        per-slot [L, F] form — the voting-parallel learner's per-leaf
+        elected feature subsets remap columns per slot.
+      nan_bin: [F] or [L, F] int32 — NaN bin index, -1 if none.
+      is_cat: [F] or [L, F] bool — categorical feature flags.
       params: SplitParams.
       feature_mask: optional [F] or [L, F] bool — candidate features,
         applied BEFORE the argmax (per-tree sampling, per-node sampling,
         interaction constraints).
-      mono_type: optional [F] int32 in {-1, 0, 1} — monotone directions.
+      mono_type: optional [F] or [L, F] int32 in {-1, 0, 1}.
       leaf_lo / leaf_hi: optional [L] f32 — per-leaf output bounds
         (BasicConstraint of monotone_constraints.hpp).
       parent_output: optional [L] f32 — each slot's current output
@@ -151,7 +155,9 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         only this bin is evaluated per (leaf, feature).
       cat_sorted_mask: optional [F] bool — categorical features with more
         than max_cat_to_onehot bins; they take the sorted-subset path
-        (ops/cat_split.py) instead of one-hot.
+        (ops/cat_split.py) instead of one-hot. Requires 1-D metadata.
+      return_feature_gain: also return "feature_gain" [L, F] — the best
+        net gain per (leaf, feature) — for voting-parallel vote rounds.
 
     Returns dict with per-leaf arrays:
       gain [L] — NET gain (split - parent - min_gain_to_split, penalized;
@@ -168,11 +174,26 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     use_smooth = params.path_smooth > 0.0
     bins_iota = jnp.arange(B, dtype=jnp.int32)
 
-    has_nan = nan_bin >= 0                                     # [F]
+    per_slot_meta = num_bins_per_feat.ndim == 2
+    if per_slot_meta and cat_sorted_mask is not None:
+        raise NotImplementedError(
+            "sorted-subset categorical splits need 1-D feature metadata "
+            "(not supported under voting-parallel subsets)")
+
+    def _2d(a):
+        return a if a is None or a.ndim == 2 else a[None, :]
+
+    nbpf = _2d(num_bins_per_feat)                              # [M, F]
+    nan2 = _2d(nan_bin)
+    cat2 = _2d(is_cat)
+    mono2 = _2d(mono_type) if use_mono else None
+
+    has_nan = nan2 >= 0                                        # [M, F]
     # zero out the nan bin so cumsums cover non-missing rows only
-    nan_mask = (bins_iota[None, :] == nan_bin[:, None]) & has_nan[:, None]
-    hist_nonan = jnp.where(nan_mask[None, :, :, None], 0.0, hist)
-    nan_sum = jnp.einsum("lfbc,fb->lfc", hist, nan_mask.astype(hist.dtype))
+    nan_mask = ((bins_iota[None, None, :] == nan2[:, :, None])
+                & has_nan[:, :, None])                         # [M, F, B]
+    hist_nonan = jnp.where(nan_mask[:, :, :, None], 0.0, hist)
+    nan_sum = (hist * nan_mask[:, :, :, None]).sum(axis=2)     # [L, F, 3]
 
     totals = hist_nonan.sum(axis=2) + nan_sum                  # [L, F, 3]
     cum = jnp.cumsum(hist_nonan, axis=2)                       # [L, F, B, 3]
@@ -185,27 +206,29 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     num_left = jnp.stack([gl0, gl1], axis=3)                   # [L,F,B,2,3]
     num_right = tot[:, :, :, None, :] - num_left
 
-    nnb = num_bins_per_feat - has_nan.astype(jnp.int32)        # non-nan bins
-    t_valid = bins_iota[None, :] < (nnb[:, None] - 1)          # [F, B]
+    nnb = nbpf - has_nan.astype(jnp.int32)                     # non-nan bins
+    t_valid = bins_iota[None, None, :] < (nnb[:, :, None] - 1)  # [M, F, B]
     # when the feature has no nan, option 1 duplicates option 0 — mask it
     opt_valid = jnp.stack(
-        [jnp.ones_like(has_nan), has_nan], axis=-1)            # [F, 2]
-    num_valid = (t_valid[:, :, None] & opt_valid[:, None, :]
-                 & (~is_cat)[:, None, None])[None]             # [1, F, B, 2]
+        [jnp.ones_like(has_nan), has_nan], axis=-1)            # [M, F, 2]
+    num_valid = (t_valid[:, :, :, None] & opt_valid[:, :, None, :]
+                 & (~cat2)[:, :, None, None])                  # [M, F, B, 2]
 
     # ---- categorical one-hot: left = {bin == t}; sorted-path features are
     # excluded here (reference picks ONE path by bin count, not best-of-both)
-    onehot_f = (is_cat & ~cat_sorted_mask) if cat_sorted_mask is not None \
-        else is_cat
+    onehot_f = (cat2 & ~cat_sorted_mask[None, :]) \
+        if cat_sorted_mask is not None else cat2
     cat_left = hist[:, :, :, None, :]                           # reuse lattice
     cat_right = tot[:, :, :, None, :] - cat_left
-    cat_ok = (bins_iota[None, :] < nnb[:, None]) & onehot_f[:, None]
-    cat_valid = (cat_ok[:, :, None]
-                 & jnp.array([True, False])[None, None, :])[None]
+    cat_ok = ((bins_iota[None, None, :] < nnb[:, :, None])
+              & onehot_f[:, :, None])                          # [M, F, B]
+    cat_valid = (cat_ok[:, :, :, None]
+                 & jnp.array([True, False])[None, None, None, :])
 
-    left = jnp.where(is_cat[None, :, None, None, None], cat_left, num_left)
-    right = jnp.where(is_cat[None, :, None, None, None], cat_right, num_right)
-    valid = jnp.where(is_cat[None, :, None, None], cat_valid, num_valid)
+    catsel = cat2[:, :, None, None, None]
+    left = jnp.where(catsel, cat_left, num_left)
+    right = jnp.where(catsel, cat_right, num_right)
+    valid = jnp.where(cat2[:, :, None, None], cat_valid, num_valid)
     if rand_bin is not None:  # extra_trees: one threshold per (leaf, feat)
         valid = valid & (bins_iota[None, None, :, None]
                          == rand_bin[:, :, None, None])
@@ -234,7 +257,7 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     gain = (gain_given_output(gL, hL, l1, l2, out_l)
             + gain_given_output(gR, hR, l1, l2, out_r))
     if use_mono:
-        mt = mono_type[None, :, None, None]
+        mt = mono2[:, :, None, None]
         viol = (((mt > 0) & (out_l > out_r)) | ((mt < 0) & (out_l < out_r)))
         gain = jnp.where(viol, 0.0, gain)  # GetSplitGains returns 0
 
@@ -252,7 +275,7 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         p_out_num = calc_output(g_tot, h_tot, l1, l2, mds,
                                 params.path_smooth, n_tot,
                                 parent_output[:, None])
-        p_out = jnp.where(is_cat[None, :], parent_output[:, None], p_out_num)
+        p_out = jnp.where(cat2, parent_output[:, None], p_out_num)
         pg = gain_given_output(g_tot, h_tot, l1, l2, p_out)
     elif mds > 0.0:
         p_out = calc_output(g_tot, h_tot, l1, l2, mds)
@@ -265,7 +288,7 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
 
     if use_mono and params.monotone_penalty > 0.0:
         pen = monotone_penalty_factor(slot_depth, params.monotone_penalty)
-        mt = mono_type[None, :, None, None]
+        mt = mono2[:, :, None, None]
         net = jnp.where(mt != 0, net * pen[:, None, None, None], net)
 
     if feature_mask is not None:
@@ -281,6 +304,7 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     thr = ((best // 2) % B).astype(jnp.int32)
     opt = (best % 2).astype(jnp.int32)
     default_left = opt == 1
+    feature_gain = net.max(axis=(2, 3)) if return_feature_gain else None
 
     def take3(a):
         af = a.reshape(L, F * B * 2, 3)
@@ -300,8 +324,10 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         "left_out": take1(out_l),
         "right_out": take1(out_r),
         "is_cat_split": jnp.take_along_axis(
-            is_cat[None, :].repeat(L, 0), feat[:, None], axis=1)[:, 0],
+            jnp.broadcast_to(cat2, (L, F)), feat[:, None], axis=1)[:, 0],
     }
+    if return_feature_gain:
+        out["feature_gain"] = feature_gain
 
     # one-hot winners' membership mask (single bin goes left)
     member = ((bins_iota[None, :] == thr[:, None])

@@ -41,7 +41,7 @@ from ..ops.split import SplitParams
 from ..boosting.tree_builder import build_tree, TreeArrays
 
 __all__ = ["make_mesh", "shard_rows", "replicate", "build_tree_dp",
-           "DataParallelPlan"]
+           "DataParallelPlan", "VotingParallelPlan", "FeatureParallelPlan"]
 
 AXIS = "data"
 
@@ -71,11 +71,15 @@ class DataParallelPlan:
     through :meth:`build_tree` below.
     """
 
+    parallel_mode = "data"   # tree_learner= analog (tree_learner.cpp:15)
+    rows_sharded = True
+
     def __init__(self, devices: Optional[Sequence[jax.Device]] = None,
-                 axis_name: str = AXIS):
+                 axis_name: str = AXIS, top_k: int = 20):
         self.mesh = make_mesh(devices, axis_name)
         self.axis_name = axis_name
         self.num_shards = self.mesh.devices.size
+        self.top_k = top_k
 
     def pad_to(self, num_rows: int, block: int) -> int:
         """Rows must divide evenly into shards × row-blocks."""
@@ -107,19 +111,150 @@ class DataParallelPlan:
             valid_bins=valid_bins, valid_row_leaf0=valid_row_leaf0,
             mono_type_pf=mono_type_pf,
             interaction_groups=interaction_groups, rng_key=rng_key,
-            feature_fraction_bynode=feature_fraction_bynode)
+            feature_fraction_bynode=feature_fraction_bynode,
+            parallel_mode=self.parallel_mode, top_k=self.top_k)
+
+
+class VotingParallelPlan(DataParallelPlan):
+    """PV-Tree voting-parallel (voting_parallel_tree_learner.cpp:16-120):
+    same row sharding as data-parallel, but per-round communication is
+    votes + the elected feature columns only — O(top_k*B) instead of
+    O(F*B). Use when F*B is large enough that the histogram psum
+    dominates ICI/DCN time."""
+    parallel_mode = "voting"
+
+
+class FeatureParallelPlan:
+    """Feature-parallel (feature_parallel_tree_learner.cpp:38-77): every
+    chip holds ALL rows (the reference's model — each worker has the full
+    dataset), split WORK is sharded by feature, and the winning split is
+    merged by a gain argmax across chips, then applied locally by every
+    chip. No histogram merge at all; the per-round communication is one
+    SplitInfo-sized pmax/psum pair per leaf batch."""
+
+    parallel_mode = "feature"
+    rows_sharded = False
+
+    def __init__(self, devices: Optional[Sequence[jax.Device]] = None,
+                 axis_name: str = AXIS, top_k: int = 20):
+        self.mesh = make_mesh(devices, axis_name)
+        self.axis_name = axis_name
+        self.num_shards = self.mesh.devices.size
+        self.top_k = top_k
+
+    def pad_to(self, num_rows: int, block: int) -> int:
+        return ((num_rows + block - 1) // block) * block
+
+    def shard_rows(self, arr):
+        # rows live whole on every chip
+        return replicate(self.mesh, arr)
+
+    def replicate(self, arr):
+        return replicate(self.mesh, arr)
+
+    def build_tree(self, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
+                   is_cat_pf, feature_mask, *, num_leaves: int,
+                   leaf_batch: int, max_depth: int, num_bins: int,
+                   split_params: SplitParams, hist_dtype: str = "bfloat16",
+                   hist_impl: str = "auto", block_rows: int = 0,
+                   valid_bins: Tuple[jax.Array, ...] = (),
+                   valid_row_leaf0: Tuple[jax.Array, ...] = (),
+                   mono_type_pf=None, interaction_groups=None,
+                   rng_key=None, feature_fraction_bynode: float = 1.0):
+        if interaction_groups is not None or \
+                feature_fraction_bynode < 1.0 or split_params.extra_trees:
+            raise NotImplementedError(
+                "tree_learner=feature does not yet compose with "
+                "interaction constraints / per-node sampling / extra_trees")
+        has_mono = mono_type_pf is not None
+        mono_arr = (mono_type_pf if has_mono
+                    else jnp.zeros_like(num_bins_pf))
+        return _build_tree_fp_jit(
+            self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
+            is_cat_pf, feature_mask,
+            tuple(valid_bins) + tuple(valid_row_leaf0), mono_arr,
+            num_leaves=num_leaves, leaf_batch=leaf_batch,
+            max_depth=max_depth, num_bins=num_bins,
+            split_params=split_params, axis_name=self.axis_name,
+            hist_dtype=hist_dtype, hist_impl=hist_impl,
+            block_rows=block_rows, n_shards=self.num_shards,
+            has_mono=has_mono)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
+                     "num_bins", "split_params", "axis_name", "hist_dtype",
+                     "hist_impl", "block_rows", "n_shards", "has_mono"))
+def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
+                       is_cat_pf, feature_mask, valid_flat, mono_arr, *,
+                       num_leaves, leaf_batch, max_depth, num_bins,
+                       split_params, axis_name, hist_dtype, hist_impl,
+                       block_rows, n_shards, has_mono):
+    R, F = bins.shape
+    # pad the feature axis so it splits evenly; pad features are trivial
+    # (1 bin, masked out) and never selected
+    F_pad = ((F + n_shards - 1) // n_shards) * n_shards
+    pf = F_pad - F
+    bins_p = jnp.pad(bins, ((0, 0), (0, pf)))
+    num_bins_p = jnp.pad(num_bins_pf, (0, pf), constant_values=1)
+    nan_bin_p = jnp.pad(nan_bin_pf, (0, pf), constant_values=-1)
+    is_cat_p = jnp.pad(is_cat_pf, (0, pf))
+    fmask_p = jnp.pad(feature_mask, (0, pf))
+    mono_p = jnp.pad(mono_arr, (0, pf))
+
+    rep = P()
+    fsh = P(axis_name)       # 1-D per-feature arrays, feature-sharded
+    fsh2 = P(None, axis_name)
+    n_valid = len(valid_flat) // 2
+
+    def step(b_full, b_loc, g, rl, nbpf, nanpf, catpf, fmask,
+             loc_nbpf, loc_nanpf, loc_catpf, loc_fmask, loc_mono,
+             mono_full, vflat):
+        vbins = tuple(vflat[:n_valid])
+        vrl = tuple(vflat[n_valid:])
+        offset = (jax.lax.axis_index(axis_name)
+                  * jnp.int32(b_loc.shape[1]))
+        return build_tree(
+            b_full, g, rl, nbpf, nanpf, catpf, fmask,
+            num_leaves=num_leaves, leaf_batch=leaf_batch,
+            max_depth=max_depth, num_bins=num_bins,
+            split_params=split_params, axis_name=axis_name,
+            hist_dtype=hist_dtype, hist_impl=hist_impl,
+            block_rows=block_rows, valid_bins=vbins, valid_row_leaf0=vrl,
+            mono_type_pf=mono_full if has_mono else None,
+            parallel_mode="feature", local_bins=b_loc,
+            local_meta=(loc_nbpf, loc_nanpf, loc_catpf, loc_fmask,
+                        loc_mono if has_mono else None),
+            feat_offset=offset)
+
+    tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
+        *([0] * len(TreeArrays._fields))))
+    valid_in_specs = tuple([rep] * (2 * n_valid))
+
+    fn = jax.shard_map(
+        step, mesh=mesh,
+        in_specs=(rep, fsh2, rep, rep, rep, rep, rep, rep,
+                  fsh, fsh, fsh, fsh, fsh, rep, valid_in_specs),
+        out_specs=(tree_specs, rep, tuple([rep] * n_valid)),
+        check_vma=False)
+    return fn(bins_p, bins_p, gh, row_leaf0, num_bins_p, nan_bin_p,
+              is_cat_p, fmask_p, num_bins_p, nan_bin_p, is_cat_p, fmask_p,
+              mono_p, mono_p, valid_flat)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
-                     "block_rows", "n_valid", "feature_fraction_bynode"))
+                     "block_rows", "n_valid", "feature_fraction_bynode",
+                     "parallel_mode", "top_k"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl, block_rows,
-                       n_valid, feature_fraction_bynode):
+                       n_valid, feature_fraction_bynode,
+                       parallel_mode="data", top_k=20):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
@@ -137,7 +272,8 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             block_rows=block_rows,
             valid_bins=vbins, valid_row_leaf0=vrl,
             mono_type_pf=mono, interaction_groups=groups, rng_key=key,
-            feature_fraction_bynode=feature_fraction_bynode)
+            feature_fraction_bynode=feature_fraction_bynode,
+            parallel_mode=parallel_mode, top_k=top_k)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
@@ -165,7 +301,8 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   valid_bins: Tuple[jax.Array, ...] = (),
                   valid_row_leaf0: Tuple[jax.Array, ...] = (),
                   mono_type_pf=None, interaction_groups=None, rng_key=None,
-                  feature_fraction_bynode: float = 1.0):
+                  feature_fraction_bynode: float = 1.0,
+                  parallel_mode: str = "data", top_k: int = 20):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
@@ -182,4 +319,5 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
         hist_dtype=hist_dtype, hist_impl=hist_impl,
             block_rows=block_rows,
         n_valid=len(valid_bins),
-        feature_fraction_bynode=feature_fraction_bynode)
+        feature_fraction_bynode=feature_fraction_bynode,
+        parallel_mode=parallel_mode, top_k=top_k)

@@ -1,0 +1,71 @@
+"""Multi-host (DCN) initialization.
+
+TPU-native replacement for the reference's machine-list networking
+(``src/network/linkers_socket.cpp:24`` Linkers ctor parses
+``machines``/``machine_list_file`` + ``local_listen_port`` and builds a
+TCP mesh; ``Network::Init`` assigns ranks). On TPU pods the transport,
+topology and collective algorithms all belong to XLA; what remains is
+process bootstrap — ``jax.distributed.initialize`` — after which
+``jax.devices()`` spans every host and the SAME DataParallelPlan /
+VotingParallelPlan / FeatureParallelPlan programs run unchanged with
+their psums riding ICI within a slice and DCN across slices.
+
+Mapping of reference params (config.h network section):
+- ``machines`` / ``machine_list_file``: list of host:port — the FIRST
+  entry becomes the JAX coordinator address.
+- ``num_machines``: process count.
+- ``local_listen_port``: unused (XLA owns transports); accepted.
+- rank: from ``LIGHTGBM_TPU_RANK`` or cloud-TPU auto-detection.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["init_distributed", "maybe_init_distributed"]
+
+_initialized = False
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None) -> None:
+    """Bring up the multi-host JAX runtime (idempotent).
+
+    With no arguments, defers entirely to jax.distributed's
+    auto-detection (TPU pod metadata / env vars) — the normal path on
+    Cloud TPU slices.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def maybe_init_distributed(config) -> bool:
+    """Config-driven init (Network::Init analog, network.cpp:45).
+
+    Returns True when multi-host init ran. ``num_machines <= 1`` is a
+    no-op, matching the reference's is_parallel gate
+    (application.cpp:171).
+    """
+    n = int(getattr(config, "num_machines", 1) or 1)
+    if n <= 1:
+        return False
+    machines = getattr(config, "machines", "") or ""
+    mlist_file = (getattr(config, "machine_list_filename", "")
+                  or getattr(config, "machine_list_file", "") or "")
+    if not machines and mlist_file and os.path.exists(mlist_file):
+        with open(mlist_file) as f:
+            machines = ",".join(ln.strip() for ln in f if ln.strip())
+    coordinator = machines.split(",")[0].strip() if machines else None
+    rank_env = os.environ.get("LIGHTGBM_TPU_RANK")
+    process_id = int(rank_env) if rank_env is not None else None
+    init_distributed(coordinator_address=coordinator,
+                     num_processes=n, process_id=process_id)
+    return True

@@ -57,6 +57,11 @@ class Tree:
         self.cat_threshold: List[int] = []
         # bin-space subsets per cat split (in-session binned replay only)
         self.cat_bitset_bins: List[np.ndarray] = []
+        # linear-tree leaves (tree.h leaf_const_/leaf_coeff_/leaf_features_)
+        self.is_linear = False
+        self.leaf_const = np.zeros(num_leaves, np.float64)
+        self.leaf_features: List[List[int]] = [[] for _ in range(num_leaves)]
+        self.leaf_coeff: List[List[float]] = [[] for _ in range(num_leaves)]
 
     # ------------------------------------------------------------------
     @classmethod
@@ -176,7 +181,25 @@ class Tree:
         return out
 
     def predict(self, X: np.ndarray) -> np.ndarray:
-        return self.leaf_value[self._traverse(X)]
+        leaves = self._traverse(X)
+        if not self.is_linear:
+            return self.leaf_value[leaves]
+        # linear leaves: const + coeff . x, NaN in any leaf feature falls
+        # back to the piecewise-constant output (tree.cpp:133-149)
+        out = np.empty(len(leaves), np.float64)
+        for s in range(self.num_leaves):
+            rows = np.nonzero(leaves == s)[0]
+            if len(rows) == 0:
+                continue
+            feats = self.leaf_features[s]
+            if not feats:
+                out[rows] = self.leaf_const[s]
+                continue
+            vals = X[np.ix_(rows, feats)]
+            nan = np.isnan(vals).any(axis=1)
+            lin = self.leaf_const[s] + vals @ np.asarray(self.leaf_coeff[s])
+            out[rows] = np.where(nan, self.leaf_value[s], lin)
+        return out
 
     def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
         return self._traverse(X)
@@ -262,7 +285,22 @@ class Tree:
                           "cat_threshold=" + join(self.cat_threshold)]
         else:
             lines += ["leaf_value=" + join(self.leaf_value, "{!r}")]
-        lines += [f"is_linear=0", f"shrinkage={self.shrinkage:g}", ""]
+        lines += [f"is_linear={int(self.is_linear)}"]
+        if self.is_linear:
+            # tree.cpp ToString linear block: per-leaf const, feature
+            # count, then flattened features / coefficients
+            lines += [
+                "leaf_const=" + join(self.leaf_const, "{!r}"),
+                "num_features=" + " ".join(
+                    str(len(c)) for c in self.leaf_coeff),
+                "leaf_features=" + " ".join(
+                    " ".join(str(f) for f in fs)
+                    for fs in self.leaf_features if fs),
+                "leaf_coeff=" + " ".join(
+                    " ".join(repr(float(c)) for c in cs)
+                    for cs in self.leaf_coeff if cs),
+            ]
+        lines += [f"shrinkage={self.shrinkage:g}", ""]
         return "\n".join(lines)
 
     @classmethod
@@ -301,6 +339,18 @@ class Tree:
                                        kv["cat_boundaries"].split()]
                 tree.cat_threshold = [int(x) for x in
                                       kv["cat_threshold"].split()]
+        if kv.get("is_linear", "0") == "1":
+            tree.is_linear = True
+            tree.leaf_const = arr("leaf_const", np.float64, num_leaves)
+            nf = arr("num_features", np.int64, num_leaves)
+            feats = [int(x) for x in kv.get("leaf_features", "").split()]
+            coefs = [float(x) for x in kv.get("leaf_coeff", "").split()]
+            pos = 0
+            for s in range(num_leaves):
+                n = int(nf[s])
+                tree.leaf_features[s] = feats[pos:pos + n]
+                tree.leaf_coeff[s] = coefs[pos:pos + n]
+                pos += n
         tree.shrinkage = float(kv.get("shrinkage", "1"))
         return tree
 
@@ -450,6 +500,10 @@ class Tree:
                         row_chunk: int = 0) -> np.ndarray:
         """[n, num_features + 1] SHAP values (last column = expected
         value); vectorized TreeSHAP (see block comment above)."""
+        if self.is_linear:
+            raise NotImplementedError(
+                "SHAP contributions are not supported for linear trees "
+                "(matches the reference's restriction)")
         n, F = X.shape
         phi = np.zeros((n, F + 1))
         phi[:, -1] = self.expected_value()
@@ -607,12 +661,19 @@ class Tree:
         def make_node(idx: int):
             if idx < 0:
                 s = ~idx
-                return {
+                rec = {
                     "leaf_index": int(s),
                     "leaf_value": float(self.leaf_value[s]),
                     "leaf_weight": float(self.leaf_weight[s]),
                     "leaf_count": int(self.leaf_count[s]),
                 }
+                if self.is_linear:  # LinearModelToJSON (tree.cpp:446)
+                    rec["leaf_const"] = float(self.leaf_const[s])
+                    rec["leaf_features"] = [int(f) for f
+                                            in self.leaf_features[s]]
+                    rec["leaf_coeff"] = [float(c) for c
+                                         in self.leaf_coeff[s]]
+                return rec
             dt = int(self.decision_type[idx])
             rec = {
                 "split_index": int(idx),
@@ -659,6 +720,10 @@ class Tree:
         DART normalization and rollback arithmetic."""
         self.leaf_value *= factor
         self.internal_value *= factor
+        if self.is_linear:
+            self.leaf_const *= factor
+            self.leaf_coeff = [[c * factor for c in cs]
+                               for cs in self.leaf_coeff]
         self.shrinkage *= factor
         return self
 

@@ -1,0 +1,67 @@
+"""Linear trees: per-leaf ridge fits (linear_tree_learner.cpp analog)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _linear_data(rng, n=2000):
+    X = rng.normal(size=(n, 5))
+    # piecewise-LINEAR target: constant leaves can only staircase this
+    y = np.where(X[:, 0] > 0, 2.0 * X[:, 1] + 1.0, -1.5 * X[:, 1] - 0.5)
+    y += rng.normal(scale=0.05, size=n)
+    return X, y
+
+
+def test_linear_beats_constant_on_piecewise_linear(rng):
+    X, y = _linear_data(rng)
+    base = {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+            "learning_rate": 0.5, "min_data_in_leaf": 20}
+    const = lgb.train(base, lgb.Dataset(X, label=y, free_raw_data=False),
+                      10)
+    lin = lgb.train(dict(base, linear_tree=True, linear_lambda=0.01),
+                    lgb.Dataset(X, label=y, free_raw_data=False), 10)
+    mse_const = np.mean((const.predict(X) - y) ** 2)
+    mse_lin = np.mean((lin.predict(X) - y) ** 2)
+    # a handful of linear leaves should crush the staircase fit
+    assert mse_lin < mse_const * 0.5, (mse_lin, mse_const)
+
+
+def test_linear_tree_text_roundtrip(rng):
+    X, y = _linear_data(rng, n=800)
+    bst = lgb.train({"objective": "regression", "num_leaves": 6,
+                     "linear_tree": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 4)
+    assert bst._gbdt.models[0].is_linear
+    txt = bst.model_to_string()
+    assert "is_linear=1" in txt and "leaf_coeff=" in txt
+    bst2 = lgb.Booster(model_str=txt)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                               rtol=1e-6, atol=1e-9)
+    d = bst.dump_model()
+    # leaf records carry the linear model
+    def find_leaf(nd):
+        if "leaf_index" in nd:
+            return nd
+        return find_leaf(nd["left_child"])
+    leaf = find_leaf(d["tree_info"][0]["tree_structure"])
+    assert "leaf_const" in leaf and "leaf_coeff" in leaf
+
+
+def test_linear_nan_falls_back_to_constant(rng):
+    X, y = _linear_data(rng, n=1000)
+    bst = lgb.train({"objective": "regression", "num_leaves": 6,
+                     "linear_tree": True, "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    Xt = X[:50].copy()
+    Xt[:, 1] = np.nan  # leaf feature now missing
+    pred = bst.predict(Xt)
+    assert np.isfinite(pred).all()
+
+
+def test_linear_tree_param_conflicts():
+    with pytest.raises(ValueError, match="regression_l1"):
+        lgb.train({"objective": "regression_l1", "linear_tree": True,
+                   "verbosity": -1},
+                  lgb.Dataset(np.zeros((50, 2)), label=np.zeros(50)), 1)

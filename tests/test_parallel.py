@@ -89,3 +89,107 @@ def test_dp_valid_copartition(rng):
         valid_row_leaf0=(plan.shard_rows(vrl0),), **KW)
 
     np.testing.assert_array_equal(np.asarray(got_v[0]), np.asarray(ref_v[0]))
+
+
+def test_feature_parallel_matches_single_device(rng):
+    """tree_learner=feature: rows replicated, split work feature-sharded,
+    winner merged by gain argmax (SyncUpGlobalBestSplit analog) — the
+    tree must be IDENTICAL to the single-device build."""
+    from lightgbm_tpu.parallel.data_parallel import FeatureParallelPlan
+    bins, gh, meta = _data(rng, F=10)
+    R = bins.shape[0]
+    rl0 = np.zeros(R, np.int32)
+
+    ref_tree, ref_rl, _ = build_tree(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R, **KW)
+
+    plan = FeatureParallelPlan()
+    got_tree, got_rl, _ = plan.build_tree(
+        plan.shard_rows(bins), plan.shard_rows(gh), plan.shard_rows(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R, **KW)
+
+    assert int(got_tree.num_leaves) == int(ref_tree.num_leaves)
+    np.testing.assert_array_equal(np.asarray(got_tree.split_feature),
+                                  np.asarray(ref_tree.split_feature))
+    np.testing.assert_array_equal(np.asarray(got_tree.threshold_bin),
+                                  np.asarray(ref_tree.threshold_bin))
+    np.testing.assert_allclose(np.asarray(got_tree.leaf_values),
+                               np.asarray(ref_tree.leaf_values),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got_rl), np.asarray(ref_rl))
+
+
+def test_voting_parallel_full_topk_matches_data_parallel(rng):
+    """With top_k >= F every feature is elected, so PV-Tree must produce
+    exactly the data-parallel tree (global sub-hist == global hist)."""
+    from lightgbm_tpu.parallel.data_parallel import VotingParallelPlan
+    bins, gh, meta = _data(rng, F=6)
+    R = bins.shape[0]
+    rl0 = np.zeros(R, np.int32)
+
+    ref_tree, ref_rl, _ = build_tree(
+        jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R, **KW)
+
+    plan = VotingParallelPlan(top_k=6)
+    nsh = plan.num_shards
+    got_tree, got_rl, _ = plan.build_tree(
+        plan.shard_rows(bins), plan.shard_rows(gh), plan.shard_rows(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R // nsh, **KW)
+
+    assert int(got_tree.num_leaves) == int(ref_tree.num_leaves)
+    np.testing.assert_array_equal(np.asarray(got_tree.split_feature),
+                                  np.asarray(ref_tree.split_feature))
+    np.testing.assert_array_equal(np.asarray(got_rl), np.asarray(ref_rl))
+
+
+def test_voting_parallel_small_topk_grows_sane_tree(rng):
+    """top_k < F: communication-restricted election still grows a full
+    tree whose splits all carry positive gain."""
+    from lightgbm_tpu.parallel.data_parallel import VotingParallelPlan
+    bins, gh, meta = _data(rng, F=12)
+    R = bins.shape[0]
+    rl0 = np.zeros(R, np.int32)
+    plan = VotingParallelPlan(top_k=2)
+    nsh = plan.num_shards
+    tree, rl, _ = plan.build_tree(
+        plan.shard_rows(bins), plan.shard_rows(gh), plan.shard_rows(rl0),
+        meta["num_bins_pf"], meta["nan_bin_pf"], meta["is_cat_pf"],
+        meta["feature_mask"], block_rows=R // nsh, **KW)
+    nl = int(tree.num_leaves)
+    assert nl > 1
+    # slots beyond num_nodes (incl. the dummy scatter sink) excluded
+    sf = np.asarray(tree.split_feature)[:int(tree.num_nodes)]
+    internal = sf[sf >= 0]
+    assert len(internal) == nl - 1
+    # every row parks in a live leaf slot
+    assert np.asarray(rl).max() < nl
+
+
+def test_end_to_end_voting_booster(rng):
+    """Full training loop with tree_learner=voting on the 8-device mesh."""
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(2048, 10))
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "tree_learner": "voting", "top_k": 3,
+                     "verbosity": -1}, ds, 8)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_end_to_end_feature_booster(rng):
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(2048, 10))
+    y = (X[:, 0] + X[:, 1] ** 2 > 0.5).astype(float)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "tree_learner": "feature", "verbosity": -1}, ds, 8)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(X)) > 0.9
