@@ -15,7 +15,11 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
 from .config import Config
 from .dataset import Dataset
 from .engine import Booster, CVBooster, cv, train
+from .log import register_logger
 from .tree import Tree
+from . import plotting
+from .plotting import (create_tree_digraph, plot_importance, plot_metric,
+                       plot_split_value_histogram, plot_tree)
 
 try:  # sklearn-style wrappers need scikit-learn at import time
     from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
@@ -28,5 +32,7 @@ __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "CVBooster", "train", "cv", "Config",
            "BinMapper", "Tree", "early_stopping", "log_evaluation",
-           "record_evaluation", "reset_parameter",
-           "EarlyStopException"] + _SKLEARN
+           "record_evaluation", "reset_parameter", "EarlyStopException",
+           "register_logger", "plotting", "plot_importance", "plot_metric",
+           "plot_split_value_histogram", "plot_tree",
+           "create_tree_digraph"] + _SKLEARN

@@ -111,7 +111,8 @@ class BinMapper:
     def from_values(cls, values: np.ndarray, max_bin: int = 255,
                     min_data_in_bin: int = 3, bin_type: str = "numerical",
                     use_missing: bool = True, zero_as_missing: bool = False,
-                    total_cnt: Optional[int] = None) -> "BinMapper":
+                    total_cnt: Optional[int] = None,
+                    forced_bounds: Optional[list] = None) -> "BinMapper":
         m = cls()
         m.bin_type = bin_type
         values = np.asarray(values, dtype=np.float64)
@@ -176,6 +177,13 @@ class BinMapper:
             bounds = _greedy_find_bin(dv, cnts, effective_max_bin,
                                       len(non_nan), min_data_in_bin)
         ub = np.asarray(bounds, dtype=np.float64)
+        if forced_bounds:
+            # forcedbins_filename (dataset_loader.cpp GetForcedBins):
+            # user-specified boundaries are guaranteed to exist; greedy
+            # bounds fill around them (bin count may exceed max_bin by
+            # up to len(forced_bounds) — a documented simplification)
+            ub = np.concatenate([ub, np.asarray(forced_bounds,
+                                                np.float64)])
         # dedupe (can collapse when greedy produced adjacent equal bounds)
         ub = np.unique(ub)
         m.bin_upper_bound = ub

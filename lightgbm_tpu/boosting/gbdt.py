@@ -123,7 +123,19 @@ class GBDT:
             maybe_init_distributed(config)
         n_dev = len(jax.devices())
         self.plan = None
-        if n_dev > 1 and config.tree_learner != "serial":
+        # CEGB and feature_contri run on the serial learner only — the
+        # reference ties CEGB to SerialTreeLearner; we follow its
+        # force-serial-with-warning pattern (config.cpp:434-437 style)
+        needs_serial = bool(
+            config.cegb_tradeoff < 1.0 or config.cegb_penalty_split > 0.0
+            or config.cegb_penalty_feature_coupled
+            or config.cegb_penalty_feature_lazy or config.feature_contri)
+        if needs_serial and n_dev > 1 and config.tree_learner != "serial":
+            from .. import log as _log
+            _log.warning("CEGB/feature_contri require the serial tree "
+                         "learner; forcing tree_learner=serial")
+        if not needs_serial and n_dev > 1 \
+                and config.tree_learner != "serial":
             from ..parallel.data_parallel import (
                 DataParallelPlan, FeatureParallelPlan, VotingParallelPlan)
             plan_cls = {"feature": FeatureParallelPlan,
@@ -269,6 +281,50 @@ class GBDT:
                 (int(config.data_random_seed) * 65537 + 17) & 0x7FFFFFFF)
             self._quantize_jit = jax.jit(self._quantize_impl)
             self._renew_jit = jax.jit(self._renew_leaf_impl)
+
+        # feature_contri: per-feature split-gain multiplier
+        # (feature_histogram.hpp:174)
+        self._gain_scale = None
+        fc = config.feature_contri
+        if fc:
+            fc = np.asarray(fc, np.float32)
+            ntf = self.train_set.num_total_features
+            if len(fc) != ntf:
+                raise ValueError(
+                    f"feature_contri has {len(fc)} entries but the "
+                    f"dataset has {ntf} features")
+            # plan is always None here: needs_serial forced serial
+            self._gain_scale = jnp.asarray(
+                fc[self.train_set.used_features])
+
+        # CEGB (cost_effective_gradient_boosting.hpp IsEnable)
+        self._cegb = None
+        self._cegb_feat_used = None
+        self._cegb_used_rows = None
+        coupled_in = config.cegb_penalty_feature_coupled
+        lazy_in = config.cegb_penalty_feature_lazy
+        if (config.cegb_tradeoff < 1.0 or config.cegb_penalty_split > 0.0
+                or coupled_in or lazy_in):
+            F_used = self.train_set.num_features
+            uf = self.train_set.used_features
+
+            def per_feat(vals, name):
+                if not vals:
+                    return None
+                vals = np.asarray(vals, np.float32)
+                if len(vals) != self.train_set.num_total_features:
+                    raise ValueError(
+                        f"{name} should be the same size as feature "
+                        "number")
+                return jnp.asarray(vals[uf])
+            coupled = per_feat(coupled_in, "cegb_penalty_feature_coupled")
+            lazy = per_feat(lazy_in, "cegb_penalty_feature_lazy")
+            self._cegb = (float(config.cegb_tradeoff),
+                          float(config.cegb_penalty_split), coupled, lazy)
+            self._cegb_feat_used = jnp.zeros((F_used,), bool)
+            if lazy is not None:
+                self._cegb_used_rows = jnp.zeros(
+                    (self.train_dd.r_pad, F_used), bool)
 
     # ------------------------------------------------------------------
     def _field_init_scores(self, init, n: int, r_pad: int) -> np.ndarray:
@@ -464,7 +520,17 @@ class GBDT:
         key = (jax.random.fold_in(
             jax.random.fold_in(self._tree_key, self.iter_), k)
             if self._tree_key is not None else None)
-        return builder(
+        kw = {}
+        if self.plan is None:
+            # single-device extras (reference ties CEGB to the serial
+            # learner; feature_contri follows for simplicity)
+            if self._gain_scale is not None:
+                kw["gain_scale"] = self._gain_scale
+            if self._cegb is not None:
+                t, ps, coupled, lazy = self._cegb
+                kw["cegb"] = (t, ps, coupled, lazy,
+                              self._cegb_feat_used, self._cegb_used_rows)
+        out = builder(
             self.train_dd.bins, gh, self.train_dd.row_leaf0,
             self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
             num_leaves=cfg.num_leaves, leaf_batch=cfg.leaf_batch,
@@ -476,7 +542,12 @@ class GBDT:
             valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd),
             mono_type_pf=self.mono_type_pf,
             interaction_groups=self.interaction_groups,
-            rng_key=key, feature_fraction_bynode=self._ffbn)
+            rng_key=key, feature_fraction_bynode=self._ffbn, **kw)
+        if "cegb" in kw:
+            tree_arrays, row_leaf, valid_rls, cegb_state = out
+            self._cegb_feat_used, self._cegb_used_rows = cegb_state
+            return tree_arrays, row_leaf, valid_rls
+        return out
 
     def _quantize_impl(self, g, h, key):
         """Stochastic rounding onto the quant grid (DiscretizeGradients,

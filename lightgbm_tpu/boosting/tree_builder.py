@@ -127,7 +127,9 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                parallel_mode: str = "data", top_k: int = 20,
                local_bins: Optional[jax.Array] = None,
                local_meta: Optional[Tuple] = None,
-               feat_offset: Optional[jax.Array] = None):
+               feat_offset: Optional[jax.Array] = None,
+               gain_scale: Optional[jax.Array] = None,
+               cegb: Optional[Tuple] = None):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
@@ -167,6 +169,20 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     use_rand = bool(sp.extra_trees)
     if (use_bynode or use_rand) and rng_key is None:
         raise ValueError("feature_fraction_bynode/extra_trees need rng_key")
+
+    # CEGB (cost_effective_gradient_boosting.hpp): per-(leaf, feature)
+    # gain penalties. cegb = (tradeoff, penalty_split, coupled[F]|None,
+    # lazy[F]|None, feat_used0[F] bool, used_rows0[R, F] bool|None);
+    # feat_used/used_rows persist ACROSS trees (model-level state) and
+    # are returned updated.
+    use_cegb = cegb is not None
+    if use_cegb:
+        (cegb_tradeoff, cegb_split, cegb_coupled, cegb_lazy,
+         feat_used0, used_rows0) = cegb
+        if axis_name is not None:
+            raise NotImplementedError(
+                "CEGB is single-device only (the reference ties it to "
+                "the serial tree learner too)")
 
     mode = parallel_mode if axis_name is not None else "data"
     if mode == "feature":
@@ -262,13 +278,39 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             rand_bin = jnp.floor(u2 * n_opt).astype(jnp.int32)
         return fmask, rand_bin
 
-    def best_for(hist2w, slot_depth, slot_valid, slots_c, t, state, key):
+    def cegb_penalty_for(slots_c, rl, t, state):
+        """[S, F] CEGB DeltaGain (cost_effective_gradient_boosting.hpp:
+        80-98): split cost scaled by leaf size + one-time coupled
+        feature cost + per-row lazy acquisition cost."""
+        node_of = jnp.take(t.leaf2node, slots_c)
+        n_leaf = jnp.take(t.node_count, node_of)              # [S]
+        delta = (cegb_tradeoff * cegb_split * n_leaf)[:, None] \
+            * jnp.ones((1, F), f32)
+        if cegb_coupled is not None:
+            delta = delta + cegb_tradeoff * jnp.where(
+                state["cegb_feat_used"][None, :], 0.0,
+                cegb_coupled[None, :])
+        if cegb_lazy is not None:
+            unused_cost = jnp.where(state["cegb_used_rows"], 0.0,
+                                    cegb_lazy[None, :])          # [R, F]
+            # dead/padded rows (rl < 0) route to the dummy segment L
+            seg = jnp.where(rl < 0, L, rl)
+            per_leaf = jax.ops.segment_sum(
+                unused_cost, seg, num_segments=L + 1)
+            delta = delta + cegb_tradeoff * jnp.take(
+                per_leaf, jnp.clip(slots_c, 0, L), axis=0)
+        return delta
+
+    def best_for(hist2w, slot_depth, slot_valid, slots_c, t, state, key,
+                 rl=None):
         lo = jnp.take(state["leaf_lo"], slots_c) if use_mono else None
         hi = jnp.take(state["leaf_hi"], slots_c) if use_mono else None
         node_of = jnp.take(t.leaf2node, slots_c)
         parent_out = jnp.take(t.node_value, node_of)
         fmask_s, rand_bin = slot_masks_and_bins(
             state.get("used_feat"), slots_c, key)
+        gain_penalty = (cegb_penalty_for(slots_c, rl, t, state)
+                        if use_cegb else None)
         if mode == "feature":
             # split search over this chip's feature slice only
             bs = find_best_splits(
@@ -325,7 +367,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 feature_mask=fmask_s, mono_type=mono_type_pf,
                 leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
                 slot_depth=slot_depth, rand_bin=rand_bin,
-                cat_sorted_mask=cat_sorted_mask)
+                cat_sorted_mask=cat_sorted_mask,
+                gain_scale=gain_scale, gain_penalty=gain_penalty)
         g = bs["gain"]
         if max_depth > 0:
             g = jnp.where(slot_depth < max_depth, g, NEG_INF)
@@ -375,6 +418,10 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                  r=jnp.asarray(0, jnp.int32))
     if use_inter:
         state["used_feat"] = jnp.zeros((L + 1, F), bool)
+    if use_cegb:
+        state["cegb_feat_used"] = feat_used0
+        if cegb_lazy is not None:
+            state["cegb_used_rows"] = used_rows0
 
     # ---------------- root ----------------
     root_slots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(0)
@@ -395,7 +442,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     slot_valid0 = jnp.zeros((2 * W,), bool).at[0].set(True)
     key0 = (jax.random.fold_in(rng_key, 0) if rng_key is not None else None)
     bs0 = best_for(hist0, jnp.zeros((2 * W,), jnp.int32), slot_valid0,
-                   root_slots.clip(0), tree, state, key0)
+                   root_slots.clip(0), tree, state, key0, rl=row_leaf0)
     bs_gain = bs_gain.at[0].set(bs0["gain"][0])
     bs_feat = bs_feat.at[0].set(bs0["feature"][0])
     bs_thr = bs_thr.at[0].set(bs0["threshold"][0])
@@ -494,8 +541,15 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             leaf_hi = leaf_hi.at[sel_s].set(hi_l).at[right_slot].set(hi_r) \
                              .at[DUMMY_LEAF].set(F32_MAX)
 
-        # -- 2c. branch feature tracking for interaction constraints
+        # -- 2c. CEGB bookkeeping (UpdateLeafBestSplits): applied splits
+        # mark their feature model-used (coupled) and their leaf's rows
+        # feature-seen (lazy)
         new_state_extra = {}
+        if use_cegb:
+            fu = st["cegb_feat_used"]
+            fbit_c = jnp.any((jnp.arange(F)[None, :] == sfeat[:, None])
+                             & valid[:, None], axis=0)
+            new_state_extra["cegb_feat_used"] = fu | fbit_c
         if use_inter:
             uf = st["used_feat"]
             parent_used = jnp.take(uf, sel_s, axis=0)            # [W, F]
@@ -543,6 +597,17 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             relabel(vb, vrl)
             for vb, vrl in zip(valid_bins, st["valid_row_leaf"]))
 
+        if use_cegb and cegb_lazy is not None:
+            # rows of split leaves have now "paid" for their feature
+            rlc_pre = jnp.where(st["row_leaf"] < 0, DUMMY_LEAF,
+                                st["row_leaf"])
+            act_r = jnp.take(pend_active, rlc_pre)
+            f_r = jnp.take(pend_feat, rlc_pre)
+            ur = st["cegb_used_rows"]
+            cur = ur[jnp.arange(R), f_r]
+            new_state_extra["cegb_used_rows"] = ur.at[
+                jnp.arange(R), f_r].set(cur | act_r)
+
         # -- 4. children histograms (both directly; see module docstring)
         slots2w = jnp.concatenate([jnp.where(valid, sel_s, -2),
                                    jnp.where(valid, right_slot, -2)])
@@ -554,7 +619,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         mid_state = dict(leaf_lo=leaf_lo, leaf_hi=leaf_hi, **new_state_extra)
         slots2w_c = jnp.where(slots2w >= 0, slots2w, DUMMY_LEAF)
         bs = best_for(hist2w, depth2w, jnp.concatenate([valid, valid]),
-                      slots2w_c, t, mid_state, keyr)
+                      slots2w_c, t, mid_state, keyr, rl=row_leaf)
 
         scatter_slots = slots2w_c
         bs_gain = st["bs_gain"].at[scatter_slots].set(bs["gain"]) \
@@ -579,4 +644,9 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         return out
 
     state = jax.lax.while_loop(cond, body, state)
+    if use_cegb:
+        cegb_out = (state["cegb_feat_used"],
+                    state.get("cegb_used_rows"))
+        return (state["tree"], state["row_leaf"],
+                state["valid_row_leaf"], cegb_out)
     return state["tree"], state["row_leaf"], state["valid_row_leaf"]

@@ -11,6 +11,8 @@ from __future__ import annotations
 import collections
 from typing import Any, Callable, Dict, List
 
+from . import log
+
 __all__ = ["CallbackEnv", "EarlyStopException", "early_stopping",
            "log_evaluation", "record_evaluation", "reset_parameter"]
 
@@ -34,7 +36,7 @@ def log_evaluation(period: int = 1, show_stdv: bool = True):
             result = "\t".join(
                 f"{name}'s {metric}: {value:g}"
                 for name, metric, value, _ in env.evaluation_result_list)
-            print(f"[{env.iteration + 1}]\t{result}")
+            log.eval_info(f"[{env.iteration + 1}]\t{result}")
     _callback.order = 10
     return _callback
 
@@ -92,14 +94,14 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             for alias in ("boosting", "boosting_type", "boost"))
         if not enabled[0]:
             if verbose:
-                print("Early stopping is not available in dart mode")
+                log.warning("Early stopping is not available in dart mode")
             return
         if not env.evaluation_result_list:
             raise ValueError(
                 "For early stopping, at least one dataset and eval metric "
                 "is required for evaluation")
         if verbose:
-            print(f"Training until validation scores don't improve for "
+            log.eval_info(f"Training until validation scores don't improve for "
                   f"{stopping_rounds} rounds")
         first_metric[0] = env.evaluation_result_list[0][1]
         for name, metric, _, bigger in env.evaluation_result_list:
@@ -115,7 +117,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     def _final_iteration_check(env, eval_name_splitted, i):
         if env.iteration == env.end_iteration - 1:
             if verbose:
-                print("Did not meet early stopping. Best iteration is:\n"
+                log.eval_info("Did not meet early stopping. Best iteration is:\n"
                       f"[{best_iter[i] + 1}]\t"
                       + "\t".join(f"{n}'s {m}: {v:g}"
                                   for n, m, v, _ in best_score_list[i]))
@@ -138,7 +140,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 continue  # train metrics don't trigger early stopping
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:
-                    print("Early stopping, best iteration is:\n"
+                    log.eval_info("Early stopping, best iteration is:\n"
                           f"[{best_iter[i] + 1}]\t"
                           + "\t".join(f"{n}'s {m}: {v:g}"
                                       for n, m, v, _ in best_score_list[i]))

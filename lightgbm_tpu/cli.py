@@ -114,10 +114,13 @@ def run(params: Dict[str, str]) -> int:
         model_in = _resolve_path(cfg.input_model, conf_dir)
         data_path = _resolve_path(cfg.data, conf_dir)
         booster = lgb.Booster(model_file=model_in)
+        n_iter = int(cfg.num_iteration_predict)
         pred = booster.predict(
             data_path, raw_score=bool(cfg.predict_raw_score),
             pred_leaf=bool(cfg.predict_leaf_index),
-            pred_contrib=bool(cfg.predict_contrib))
+            pred_contrib=bool(cfg.predict_contrib),
+            start_iteration=int(cfg.start_iteration_predict),
+            num_iteration=None if n_iter <= 0 else n_iter)
         out = np.asarray(pred)
         with open(cfg.output_result, "w") as f:
             if out.ndim == 1:

@@ -63,6 +63,12 @@ PARAMS: Dict[str, ParamSpec] = {
            doc="auto | serial | data | feature | voting — auto scales to "
                "every local device (data-parallel) when more than one is "
                "visible; serial pins one device"),
+        # -- accepted no-ops on TPU (documented, not silently wrong):
+        # num_threads/force_*_wise tune OpenMP & CPU histogram layout —
+        # XLA owns scheduling here and hist_impl selects the kernel;
+        # device_type is always the JAX backend; feature_pre_filter,
+        # precise_float_parser, parser_config_file, time_out concern the
+        # reference's CPU parser/socket stack.
         _p("num_threads", 0, int, aliases=("num_thread", "nthread", "nthreads",
                                            "n_jobs")),
         _p("device_type", "tpu", str, aliases=("device",)),
@@ -406,6 +412,11 @@ class Config:
                 # linear per-row outputs would corrupt running scores
                 raise ValueError(
                     "linear_tree is not supported with boosting=dart")
+        if v.get("lambdarank_position_bias_regularization", 0.0):
+            raise NotImplementedError(
+                "lambdarank position bias learning (rank_objective.hpp:30 "
+                "+ .position files) is not implemented; unset "
+                "lambdarank_position_bias_regularization")
         if self.objective in ("multiclass", "multiclassova") \
                 and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objective")

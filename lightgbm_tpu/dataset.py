@@ -161,14 +161,31 @@ class Dataset:
                 sample = data[sample_idx]
             else:
                 sample = data
+            # per-feature bin caps + forced boundaries
+            # (max_bin_by_feature, forcedbins_filename —
+            # dataset_loader.cpp:619-653 GetForcedBins)
+            mbf = list(cfg.max_bin_by_feature or [])
+            if mbf and len(mbf) != self.num_total_features:
+                raise ValueError(
+                    f"max_bin_by_feature has {len(mbf)} entries but the "
+                    f"dataset has {self.num_total_features} features")
+            forced: Dict[int, list] = {}
+            if cfg.forcedbins_filename:
+                import json as _json
+                with open(cfg.forcedbins_filename) as fh:
+                    for item in _json.load(fh):
+                        forced[int(item["feature"])] = [
+                            float(x) for x in item["bin_upper_bound"]]
             self.bin_mappers = []
             for f in range(self.num_total_features):
                 bt = "categorical" if f in cat_idx else "numerical"
                 m = BinMapper.from_values(
-                    sample[:, f], max_bin=cfg.max_bin,
+                    sample[:, f],
+                    max_bin=int(mbf[f]) if mbf else cfg.max_bin,
                     min_data_in_bin=cfg.min_data_in_bin, bin_type=bt,
                     use_missing=cfg.use_missing,
-                    zero_as_missing=cfg.zero_as_missing)
+                    zero_as_missing=cfg.zero_as_missing,
+                    forced_bounds=forced.get(f))
                 self.bin_mappers.append(m)
             self.used_features = np.asarray(
                 [f for f, m in enumerate(self.bin_mappers)

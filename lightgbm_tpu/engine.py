@@ -17,6 +17,7 @@ import numpy as np
 
 from .boosting import create_boosting
 from .boosting.gbdt import GBDT
+from . import log, profiler
 from .callback import CallbackEnv, EarlyStopException
 from .config import Config
 from .dataset import Dataset
@@ -179,7 +180,7 @@ class Booster:
         self._gbdt.rollback_one_iter()
         return self
 
-    def refit(self, data, label, decay_rate: float = 0.9,
+    def refit(self, data, label, decay_rate: Optional[float] = None,
               **kwargs) -> "Booster":
         """New Booster with this model's tree STRUCTURES and leaf values
         re-fit to `data`/`label` (basic.py Booster.refit +
@@ -190,6 +191,8 @@ class Booster:
         from .ops.split import leaf_output as _leaf_output_fn
         import jax.numpy as jnp
 
+        if decay_rate is None:
+            decay_rate = float(Config(self.params).refit_decay_rate)
         X = self._as_matrix(data)
         y = np.asarray(label, np.float64).reshape(-1)
         cfg = Config(self.params)
@@ -436,7 +439,12 @@ class Booster:
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0,
-                   importance_type: str = "split"):
+                   importance_type: Optional[str] = None):
+        if importance_type is None:
+            # saved_feature_importance_type (gbdt_model_text.cpp / config)
+            importance_type = ("gain" if int(Config(self.params)
+                               .saved_feature_importance_type) == 1
+                               else "split")
         with open(filename, "w") as f:
             f.write(self.model_to_string(num_iteration, start_iteration,
                                          importance_type))
@@ -542,6 +550,7 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     """Main training loop (engine.py:109 analog)."""
     params = dict(params or {})
     cfg = Config(params)
+    log.set_verbosity(int(cfg.verbosity))
     if "num_iterations" in cfg.explicit():  # any registered alias resolves
         num_boost_round = cfg.num_iterations
     if callable(params.get("objective")):
@@ -614,7 +623,10 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                                  end_iteration, None)
         for cb in callbacks_before:
             cb(env_before)
-        stop = booster.update(fobj=fobj)
+        # step marker for jax.profiler traces (profiler.trace) — the
+        # per-iteration timing hook of gbdt.cpp:246-249
+        with profiler.step_annotation("boost_iter", step_num=i):
+            stop = booster.update(fobj=fobj)
         evals = []
         need_eval = bool(callbacks_after) or cfg.early_stopping_round > 0
         if need_eval:

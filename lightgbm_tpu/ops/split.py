@@ -129,7 +129,9 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
                      slot_depth: Optional[jax.Array] = None,
                      rand_bin: Optional[jax.Array] = None,
                      cat_sorted_mask: Optional[jax.Array] = None,
-                     return_feature_gain: bool = False
+                     return_feature_gain: bool = False,
+                     gain_scale: Optional[jax.Array] = None,
+                     gain_penalty: Optional[jax.Array] = None
                      ) -> Dict[str, jax.Array]:
     """Vectorized best split per leaf.
 
@@ -158,6 +160,12 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         (ops/cat_split.py) instead of one-hot. Requires 1-D metadata.
       return_feature_gain: also return "feature_gain" [L, F] — the best
         net gain per (leaf, feature) — for voting-parallel vote rounds.
+      gain_scale: optional [F] or [L, F] f32 — multiplies each feature's
+        net gain (feature_contri, feature_histogram.hpp:174
+        ``output->gain *= meta_->penalty``).
+      gain_penalty: optional [L, F] f32 — subtracted from each feature's
+        net gain AFTER scaling (CEGB DeltaGain,
+        cost_effective_gradient_boosting.hpp:80-98).
 
     Returns dict with per-leaf arrays:
       gain [L] — NET gain (split - parent - min_gain_to_split, penalized;
@@ -291,6 +299,18 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         mt = mono2[:, :, None, None]
         net = jnp.where(mt != 0, net * pen[:, None, None, None], net)
 
+    if gain_scale is not None:
+        gs2 = gain_scale if gain_scale.ndim == 2 else gain_scale[None, :]
+        net = jnp.where(jnp.isfinite(net),
+                        net * gs2[:, :, None, None], net)
+    if gain_penalty is not None:
+        net = jnp.where(jnp.isfinite(net),
+                        net - gain_penalty[:, :, None, None], net)
+    if gain_scale is not None or gain_penalty is not None:
+        # scaled/penalized gains that dropped to <= 0 are no longer
+        # splittable (the reference stops on gain <= 0 downstream)
+        net = jnp.where(net > 1e-10, net, NEG_INF)
+
     if feature_mask is not None:
         fm = (feature_mask[None, :] if feature_mask.ndim == 1
               else feature_mask)                                # [L, F]
@@ -340,6 +360,21 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
             hist, num_bins_per_feat, cat_sorted_mask, params, pg,
             feature_mask=feature_mask, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
             parent_output=parent_output, rand_bin=rand_bin)
+        # sorted-cat candidates compete against scaled/penalized gains —
+        # charge them the same feature_contri scale and CEGB penalty
+        if gain_scale is not None or gain_penalty is not None:
+            sg = srt["gain"]
+            sf = srt["feature"][:, None]
+            if gain_scale is not None:
+                gs2b = jnp.broadcast_to(
+                    gain_scale if gain_scale.ndim == 2
+                    else gain_scale[None, :], (L, F))
+                sg = jnp.where(jnp.isfinite(sg), sg * jnp.take_along_axis(
+                    gs2b, sf, axis=1)[:, 0], sg)
+            if gain_penalty is not None:
+                sg = jnp.where(jnp.isfinite(sg), sg - jnp.take_along_axis(
+                    gain_penalty, sf, axis=1)[:, 0], sg)
+            srt["gain"] = jnp.where(sg > 1e-10, sg, NEG_INF)
         pick = srt["gain"] > out["gain"]
         out["gain"] = jnp.where(pick, srt["gain"], out["gain"])
         out["feature"] = jnp.where(pick, srt["feature"], out["feature"])

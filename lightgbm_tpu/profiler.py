@@ -1,0 +1,46 @@
+"""Profiling / tracing hooks.
+
+Analog of the reference timing instrumentation (``Common::Timer`` /
+``FunctionTimer``, common.h:973,1037, compiled under TIMETAG) — on TPU
+the native tool is the XLA profiler: ``jax.profiler`` traces viewable in
+TensorBoard/Perfetto, with per-iteration step markers emitted by
+engine.train (StepTraceAnnotation).
+
+Usage::
+
+    with lightgbm_tpu.profiler.trace("/tmp/tb"):
+        lgb.train(params, ds, 100)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+__all__ = ["trace", "step_annotation", "annotate"]
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Capture an XLA profiler trace of the enclosed block."""
+    import jax
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def step_annotation(name: str, step_num: Optional[int] = None):
+    """Step marker context (the per-iteration wall-clock log of
+    gbdt.cpp:246-249, as trace events)."""
+    import jax
+    kwargs = {} if step_num is None else {"step_num": step_num}
+    return jax.profiler.StepTraceAnnotation(name, **kwargs)
+
+
+def annotate(name: str):
+    """Named sub-scope inside a step (global_timer sections analog)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,95 @@
+"""CEGB + feature_contri + per-feature binning controls."""
+
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _data(rng, n=1500, f=6):
+    X = rng.normal(size=(n, f))
+    y = X[:, 0] + 0.8 * X[:, 1] + 0.1 * X[:, 2] + \
+        rng.normal(scale=0.1, size=n)
+    return X, y
+
+
+def test_cegb_coupled_penalty_limits_features(rng):
+    """A large one-time acquisition cost on all-but-one feature should
+    concentrate splits on the cheap feature."""
+    X, y = _data(rng)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1}
+    free = lgb.train(base, lgb.Dataset(X, label=y), 5)
+    cost = [0.0] + [1e6] * 5      # only feature 0 is cheap
+    pen = lgb.train(dict(base, cegb_tradeoff=1.0,
+                         cegb_penalty_feature_coupled=cost),
+                    lgb.Dataset(X, label=y), 5)
+    used_free = set()
+    used_pen = set()
+    for t in free._gbdt.models:
+        used_free.update(np.asarray(t.split_feature).tolist())
+    for t in pen._gbdt.models:
+        used_pen.update(np.asarray(t.split_feature).tolist())
+    assert used_pen == {0}, used_pen
+    assert len(used_free) > 1
+
+
+def test_cegb_split_penalty_prunes(rng):
+    X, y = _data(rng)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    free = lgb.train(base, lgb.Dataset(X, label=y), 3)
+    pen = lgb.train(dict(base, cegb_tradeoff=1.0,
+                         cegb_penalty_split=0.5), lgb.Dataset(X, label=y),
+                    3)
+    n_free = sum(t.num_leaves for t in free._gbdt.models)
+    n_pen = sum(t.num_leaves for t in pen._gbdt.models)
+    assert n_pen < n_free, (n_pen, n_free)
+
+
+def test_cegb_lazy_penalty_trains(rng):
+    X, y = _data(rng, n=800)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "cegb_tradeoff": 0.5,
+                     "cegb_penalty_feature_lazy": [0.01] * 6},
+                    lgb.Dataset(X, label=y), 4)
+    pred = bst.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
+
+
+def test_feature_contri_steers_splits(rng):
+    X, y = _data(rng)
+    contri = [1.0, 0.01, 1.0, 1.0, 1.0, 1.0]  # punish feature 1
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "feature_contri": contri},
+                    lgb.Dataset(X, label=y), 5)
+    imp = bst.feature_importance()
+    # feature 0 dominates once feature 1's gains are scaled down
+    assert imp[0] > imp[1]
+
+
+def test_max_bin_by_feature(rng):
+    X, y = _data(rng, n=2000)
+    ds = lgb.Dataset(X, label=y, params={
+        "max_bin_by_feature": [8, 255, 255, 255, 255, 255]})
+    ds.construct()
+    assert ds.bin_mappers[0].num_bin <= 9   # 8 (+ nan slack)
+    assert ds.bin_mappers[1].num_bin > 20
+
+
+def test_forced_bins(tmp_path, rng):
+    X, y = _data(rng, n=2000)
+    fb = [{"feature": 0, "bin_upper_bound": [0.3, 0.35, 0.4]}]
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps(fb))
+    ds = lgb.Dataset(X, label=y, params={"forcedbins_filename": str(p)})
+    ds.construct()
+    ub = ds.bin_mappers[0].bin_upper_bound
+    for b in (0.3, 0.35, 0.4):
+        assert np.any(np.isclose(ub, b)), (b, ub)
+
+
+def test_position_bias_param_raises():
+    with pytest.raises(NotImplementedError, match="position bias"):
+        lgb.Config({"lambdarank_position_bias_regularization": 0.5})
