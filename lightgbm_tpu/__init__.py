@@ -13,7 +13,7 @@ from .binning import BinMapper
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
-from .dataset import Dataset
+from .dataset import Dataset, Sequence
 from .engine import Booster, CVBooster, cv, train
 from .log import register_logger
 from .tree import Tree
@@ -31,7 +31,7 @@ except ImportError:  # pragma: no cover
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "CVBooster", "train", "cv", "Config",
-           "BinMapper", "Tree", "early_stopping", "log_evaluation",
+           "BinMapper", "Tree", "Sequence", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
            "register_logger", "plotting", "plot_importance", "plot_metric",
            "plot_split_value_histogram", "plot_tree",

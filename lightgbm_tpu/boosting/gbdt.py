@@ -103,7 +103,21 @@ class GBDT:
 
         F = self.train_set.num_features
         self.B = int(self.train_set.max_num_bin)
-        self.block = block_rows_for(self.train_set.num_data, F, self.B)
+        # EFB: bins are bundled [R, G]; histogram sizing follows the
+        # bundle lattice, split finding stays in feature space
+        bp = self.train_set.bundle_plan
+        self._bundle_meta = None
+        self._bundle_bins = 0
+        if bp is not None:
+            self._bundle_meta = (jnp.asarray(bp.feat_bundle),
+                                 jnp.asarray(bp.feat_offset),
+                                 jnp.asarray(bp.feat_mfb))
+            self._bundle_bins = int(bp.max_bundle_bins)
+            self.block = block_rows_for(
+                self.train_set.num_data, bp.num_bundles,
+                bp.max_bundle_bins)
+        else:
+            self.block = block_rows_for(self.train_set.num_data, F, self.B)
         # data-parallel over every local device (tree_learner param,
         # tree_learner.cpp:15 factory analog; "serial" pins one device)
         if bool(config.linear_tree):
@@ -141,6 +155,13 @@ class GBDT:
             plan_cls = {"feature": FeatureParallelPlan,
                         "voting": VotingParallelPlan}.get(
                             config.tree_learner, DataParallelPlan)
+            if self._bundle_meta is not None and \
+                    plan_cls is not DataParallelPlan:
+                from .. import log as _log
+                _log.warning(
+                    "EFB-bundled datasets support data-parallel only; "
+                    "ignoring tree_learner=" + config.tree_learner)
+                plan_cls = DataParallelPlan
             self.plan = plan_cls(top_k=int(config.top_k))
             if self.plan.rows_sharded:
                 # keep the scan block well under the per-shard row count
@@ -470,13 +491,43 @@ class GBDT:
                     jax.random.PRNGKey(cfg.bagging_seed), it)
                 return self._goss_jit(g, h, key)
             return g, h, base_mask
-        if cfg.bagging_freq > 0 and cfg.bagging_fraction < 1.0:
+        balanced = (cfg.pos_bagging_fraction < 1.0
+                    or cfg.neg_bagging_fraction < 1.0)
+        if cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
+                                     or balanced):
             if it % cfg.bagging_freq == 0 or self._bag_mask is None:
                 n = self.train_dd.num_data
-                cnt = max(1, int(n * cfg.bagging_fraction))
-                idx = self._rng_bagging.choice(n, cnt, replace=False)
                 m = np.zeros(R, np.float32)
-                m[idx] = 1.0
+                if balanced:
+                    # balanced bagging (bagging.hpp:146-165): positives
+                    # and negatives subsampled at their own rates
+                    lbl = np.asarray(self.train_set.get_label())[:n]
+                    pos = np.nonzero(lbl > 0)[0]
+                    neg = np.nonzero(lbl <= 0)[0]
+                    for rows, frac in ((pos, cfg.pos_bagging_fraction),
+                                       (neg, cfg.neg_bagging_fraction)):
+                        if len(rows) == 0:
+                            continue
+                        cnt = max(1, int(len(rows) * frac))
+                        m[self._rng_bagging.choice(rows, cnt,
+                                                   replace=False)] = 1.0
+                elif cfg.bagging_by_query:
+                    if self.train_set.group is None:
+                        raise ValueError(
+                            "bagging_by_query needs query/group data on "
+                            "the training Dataset")
+                    # sample whole queries (bagging_by_query,
+                    # bagging.hpp:36,169) so ranking lists stay intact
+                    bounds = self.train_set.query_boundaries()
+                    nq = len(bounds) - 1
+                    cnt = max(1, int(nq * cfg.bagging_fraction))
+                    qs = self._rng_bagging.choice(nq, cnt, replace=False)
+                    for q in qs:
+                        m[bounds[q]:bounds[q + 1]] = 1.0
+                else:
+                    cnt = max(1, int(n * cfg.bagging_fraction))
+                    idx = self._rng_bagging.choice(n, cnt, replace=False)
+                    m[idx] = 1.0
                 self._bag_mask = jnp.asarray(m)
             mask = self._bag_mask
             return g * mask, h * mask, mask
@@ -521,6 +572,9 @@ class GBDT:
             jax.random.fold_in(self._tree_key, self.iter_), k)
             if self._tree_key is not None else None)
         kw = {}
+        if self._bundle_meta is not None:
+            kw["bundle_meta"] = self._bundle_meta
+            kw["bundle_bins"] = self._bundle_bins
         if self.plan is None:
             # single-device extras (reference ties CEGB to the serial
             # learner; feature_contri follows for simplicity)
@@ -781,7 +835,9 @@ class GBDT:
         tree_arrays, _ = self.device_trees[idx]
         dd = self.train_dd if which < 0 else self.valid_dd[which]
         from ..ops.predict import predict_bins_value
-        return predict_bins_value(tree_arrays, self.nan_bin_pf, dd.bins)
+        return predict_bins_value(tree_arrays, self.nan_bin_pf, dd.bins,
+                                  bundle_meta=self._bundle_meta,
+                                  num_bins_pf=self.num_bins_pf)
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self):
@@ -794,8 +850,9 @@ class GBDT:
             return
         uf = self.train_set.used_features
         nan_bins = np.asarray(self.nan_bin_pf)
-        bins_h = np.asarray(self.train_dd.bins)
-        vbins_h = [np.asarray(dd.bins) for dd in self.valid_dd]
+        bins_h = self._host_feature_bins(np.asarray(self.train_dd.bins))
+        vbins_h = [self._host_feature_bins(np.asarray(dd.bins))
+                   for dd in self.valid_dd]
 
         def row_outputs(tree, binned, raw, r_pad):
             # linear trees carry per-row outputs that the binned replay
@@ -823,6 +880,24 @@ class GBDT:
             if self.keep_device_trees:
                 self.device_trees.pop()
         self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    def _host_feature_bins(self, bins_h: np.ndarray) -> np.ndarray:
+        """Decode an EFB-bundled host bins matrix back to per-feature
+        bins (identity when unbundled) — for host-side binned replay."""
+        bp = self.train_set.bundle_plan
+        if bp is None:
+            return bins_h
+        from ..efb import decode_feature_bins
+        nb = np.asarray(self.num_bins_pf)
+        F = len(bp.feat_bundle)
+        out = np.empty((bins_h.shape[0], F), np.int32)
+        for f in range(F):
+            raw = bins_h[:, bp.feat_bundle[f]].astype(np.int64)
+            out[:, f] = decode_feature_bins(
+                raw, int(bp.feat_offset[f]), int(nb[f]),
+                int(bp.feat_mfb[f]))
+        return out
 
     # ------------------------------------------------------------------
     def get_training_scores(self) -> np.ndarray:

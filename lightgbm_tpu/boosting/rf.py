@@ -132,8 +132,9 @@ class RF(GBDT):
         n = float(self.iter_ + self.num_init_iteration)
         uf = self.train_set.used_features
         nan_bins = np.asarray(self.nan_bin_pf)
-        bins_h = np.asarray(self.train_dd.bins)
-        vbins_h = [np.asarray(dd.bins) for dd in self.valid_dd]
+        bins_h = self._host_feature_bins(np.asarray(self.train_dd.bins))
+        vbins_h = [self._host_feature_bins(np.asarray(dd.bins))
+                   for dd in self.valid_dd]
         for k in range(self.K):
             tree = self.models[-(self.K - k)]
             pred = jnp.asarray(tree.predict_binned(bins_h, uf, nan_bins),

@@ -108,7 +108,7 @@ def _round_int(x):
     static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
                      "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "feature_fraction_bynode",
-                     "parallel_mode", "top_k"))
+                     "parallel_mode", "top_k", "bundle_bins"))
 def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
@@ -129,7 +129,9 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                local_meta: Optional[Tuple] = None,
                feat_offset: Optional[jax.Array] = None,
                gain_scale: Optional[jax.Array] = None,
-               cegb: Optional[Tuple] = None):
+               cegb: Optional[Tuple] = None,
+               bundle_meta: Optional[Tuple] = None,
+               bundle_bins: int = 0):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
@@ -152,7 +154,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
       are gathered and psum'd (communication O(top_k·B), not O(F·B));
       the split is chosen from those global sub-histograms.
     """
-    R, F = bins.shape
+    R = bins.shape[0]
+    F = num_bins_pf.shape[0]   # per-FEATURE count (bins may be bundled)
     L = num_leaves
     W = max(1, min(leaf_batch, L - 1))
     MAXN = 2 * L - 1
@@ -162,6 +165,45 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     BW = (B + 31) // 32     # cat bitset words
 
     f32 = jnp.float32
+
+    # EFB (efb.py): bins is a [R, G] BUNDLED matrix; histograms are
+    # built in bundle space (lattice G x bundle_bins) then gathered back
+    # to per-feature space, with the most-frequent bin reconstructed via
+    # FixHistogram accounting (dataset.cpp:1488 analog).
+    use_bundle = bundle_meta is not None
+    if use_bundle:
+        b_gof, b_off, b_mfb = bundle_meta
+        G = bins.shape[1]
+
+        def unbundle(hg):
+            S = hg.shape[0]
+            hflat = hg.reshape(S, G * bundle_bins, HIST_CH)
+            idx = (b_gof[:, None] * bundle_bins + b_off[:, None]
+                   + jnp.arange(B, dtype=jnp.int32)[None, :])    # [F, B]
+            bvalid = (jnp.arange(B, dtype=jnp.int32)[None, :]
+                      < num_bins_pf[:, None])
+            idx = jnp.clip(idx, 0, G * bundle_bins - 1)
+            hf = jnp.take(hflat, idx.reshape(-1), axis=1).reshape(
+                S, F, B, HIST_CH)
+            hf = jnp.where(bvalid[None, :, :, None], hf, 0.0)
+            totals = hg[:, 0, :, :].sum(axis=1)                  # [S, 3]
+            mfb_oh = (jnp.arange(B, dtype=jnp.int32)[None, :]
+                      == b_mfb[:, None])                         # [F, B]
+            sum_all = hf.sum(axis=2)
+            at_mfb = (hf * mfb_oh[None, :, :, None]).sum(axis=2)
+            mfb_val = totals[:, None, :] - (sum_all - at_mfb)
+            return jnp.where((mfb_oh & bvalid)[None, :, :, None],
+                             mfb_val[:, :, None, :], hf)
+
+        def feature_bin_of(bmat, feat):
+            from ..efb import decode_feature_bins
+            raw = row_feature_gather(bmat, jnp.take(b_gof, feat))
+            return decode_feature_bins(
+                raw, jnp.take(b_off, feat), jnp.take(num_bins_pf, feat),
+                jnp.take(b_mfb, feat), xp=jnp)
+    else:
+        def feature_bin_of(bmat, feat):
+            return row_feature_gather(bmat, feat)
     sp = split_params
     use_mono = mono_type_pf is not None
     use_inter = interaction_groups is not None
@@ -185,6 +227,9 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 "the serial tree learner too)")
 
     mode = parallel_mode if axis_name is not None else "data"
+    if use_bundle and mode in ("feature", "voting"):
+        raise NotImplementedError(
+            "EFB-bundled datasets support serial/data tree learners only")
     if mode == "feature":
         if local_bins is None or local_meta is None or feat_offset is None:
             raise ValueError(
@@ -216,6 +261,12 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
                 axis_name=axis_name, merge=False,
                 hist_dtype=hist_dtype, impl=hist_impl)
+        if use_bundle:
+            hg = build_histograms(
+                bins, gh, rl, slots, num_bins=bundle_bins,
+                block_rows=block_rows, axis_name=axis_name,
+                hist_dtype=hist_dtype, impl=hist_impl)
+            return unbundle(hg)
         return build_histograms(
             bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
             axis_name=axis_name, hist_dtype=hist_dtype, impl=hist_impl)
@@ -574,7 +625,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             rlc = jnp.where(rl < 0, DUMMY_LEAF, rl)
             active = jnp.take(pend_active, rlc)
             feat = jnp.take(pend_feat, rlc)
-            binv = row_feature_gather(bmat, feat)
+            binv = feature_bin_of(bmat, feat)
             thr = jnp.take(pend_thr, rlc)
             nb = jnp.take(nan_bin_pf, feat)
             isnan = (binv == nb) & (nb >= 0)

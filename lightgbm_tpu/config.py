@@ -89,6 +89,13 @@ PARAMS: Dict[str, ParamSpec] = {
            aliases=("sub_row", "subsample", "bagging"),
            check=lambda v: 0 < v <= 1),
         _p("bagging_freq", 0, int, aliases=("subsample_freq",)),
+        _p("pos_bagging_fraction", 1.0, float,
+           aliases=("pos_sub_row", "pos_subsample", "pos_bagging"),
+           check=lambda v: 0 < v <= 1),
+        _p("neg_bagging_fraction", 1.0, float,
+           aliases=("neg_sub_row", "neg_subsample", "neg_bagging"),
+           check=lambda v: 0 < v <= 1),
+        _p("bagging_by_query", False, bool),
         _p("bagging_seed", 3, int, aliases=("bagging_fraction_seed",)),
         _p("feature_fraction", 1.0, float,
            aliases=("sub_feature", "colsample_bytree"),
@@ -173,6 +180,10 @@ PARAMS: Dict[str, ParamSpec] = {
         _p("is_enable_sparse", True, bool,
            aliases=("is_sparse", "enable_sparse", "sparse")),
         _p("enable_bundle", True, bool, aliases=("is_enable_bundle", "bundle")),
+        _p("max_conflict_rate", 0.0, float, check=lambda v: 0 <= v < 1),
+        _p("max_bundle_bins", 256, int, check=lambda v: v >= 4,
+           doc="TPU EFB cap: total bins per bundle column (256 keeps "
+               "uint8 storage; also the histogram lattice width unit)"),
         _p("use_missing", True, bool),
         _p("zero_as_missing", False, bool),
         _p("feature_pre_filter", True, bool),

@@ -7,9 +7,11 @@ TPU-native analog of the reference data layer (LightGBM
 Design differences (TPU-first):
 - The reference stores per-feature-group packed columns (dense/sparse bins,
   EFB bundles) tuned for CPU cache behavior. On TPU the histogram kernel
-  wants one dense row-major ``[num_data, num_features]`` bin matrix in HBM
-  (uint8 when max_bin <= 256) feeding the MXU one-hot matmul — sparse
-  storage would force gathers. EFB is unnecessary for the same reason.
+  wants one dense row-major bin matrix in HBM (uint8 when bins <= 256)
+  feeding the MXU one-hot matmul — sparse storage would force gathers.
+  For high-dimensional sparse data, EFB (efb.py) packs mutually-exclusive
+  features into shared columns so the matrix (and the matmul lattice)
+  scales with bundles, not features.
 - Rows are padded to a multiple of the histogram row-block so every shape
   under jit is static; padded rows carry ``row_leaf = -1`` and zero
   grad/hess weight so they never contribute.
@@ -21,12 +23,42 @@ from __future__ import annotations
 
 import os
 import numpy as np
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
 
 from .binning import BinMapper
 from .config import Config
 
-__all__ = ["Dataset"]
+__all__ = ["Dataset", "Sequence"]
+
+
+class Sequence:
+    """Generic batched-row data access (basic.py:915 Sequence analog).
+
+    Subclass and implement ``__getitem__`` (int -> 1-D row, slice -> 2-D
+    batch) and ``__len__``. Dataset streams rows through it in
+    ``batch_size`` chunks — the raw matrix never materializes, the analog
+    of the reference's two-round loading + LGBM_DatasetPushRows
+    streaming ingestion (c_api).
+    """
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):
+        raise NotImplementedError("Sequence must implement __getitem__")
+
+    def __len__(self):
+        raise NotImplementedError("Sequence must implement __len__")
+
+
+def _is_sequence_input(data) -> bool:
+    if isinstance(data, Sequence):
+        return True
+    return (isinstance(data, list) and len(data) > 0
+            and all(isinstance(s, Sequence) for s in data))
+
+
+def _is_sparse(data) -> bool:
+    return hasattr(data, "tocsc") and hasattr(data, "tocsr")
 
 
 def _to_2d_float(data) -> np.ndarray:
@@ -69,7 +101,8 @@ class Dataset:
 
         self.bin_mappers: List[BinMapper] = []
         self.raw_values: Optional[np.ndarray] = None  # kept for linear_tree
-        self.bins: Optional[np.ndarray] = None      # [num_data, F] int
+        self.bundle_plan = None                     # EFB layout (efb.py)
+        self.bins: Optional[np.ndarray] = None      # [num_data, F|G] int
         self.num_data: int = 0
         self.num_total_features: int = 0
         self.used_features: Optional[np.ndarray] = None  # indices of
@@ -87,6 +120,8 @@ class Dataset:
             # a valid set needs its train set's bin mappers (and, for
             # LibSVM, its width) before anything else happens
             self.reference.construct()
+        if _is_sequence_input(self._raw_data):
+            return self._construct_from_sequences()
         file_names: Optional[List[str]] = None
         from_file = isinstance(self._raw_data, (str, os.PathLike))
         if from_file and self._is_binary_file(self._raw_data):
@@ -114,7 +149,15 @@ class Dataset:
                 self.group = loaded.group
             if self.init_score is None and loaded.init_score is not None:
                 self.init_score = loaded.init_score
-        data = _to_2d_float(self._raw_data)
+        sparse = _is_sparse(self._raw_data)
+        if sparse:
+            # scipy CSR/CSC input: binning samples densify per-row, full
+            # extraction streams per-column — the dense [R, F] matrix
+            # never materializes (SparseBin/CSR ingestion analog)
+            data = self._raw_data.tocsr()
+            data_csc = None
+        else:
+            data = _to_2d_float(self._raw_data)
         if (self.reference is not None
                 and data.shape[1] != self.reference.num_total_features):
             if from_file and data.shape[1] < \
@@ -161,47 +204,58 @@ class Dataset:
                 sample = data[sample_idx]
             else:
                 sample = data
-            # per-feature bin caps + forced boundaries
-            # (max_bin_by_feature, forcedbins_filename —
-            # dataset_loader.cpp:619-653 GetForcedBins)
-            mbf = list(cfg.max_bin_by_feature or [])
-            if mbf and len(mbf) != self.num_total_features:
-                raise ValueError(
-                    f"max_bin_by_feature has {len(mbf)} entries but the "
-                    f"dataset has {self.num_total_features} features")
-            forced: Dict[int, list] = {}
-            if cfg.forcedbins_filename:
-                import json as _json
-                with open(cfg.forcedbins_filename) as fh:
-                    for item in _json.load(fh):
-                        forced[int(item["feature"])] = [
-                            float(x) for x in item["bin_upper_bound"]]
-            self.bin_mappers = []
-            for f in range(self.num_total_features):
-                bt = "categorical" if f in cat_idx else "numerical"
-                m = BinMapper.from_values(
-                    sample[:, f],
-                    max_bin=int(mbf[f]) if mbf else cfg.max_bin,
-                    min_data_in_bin=cfg.min_data_in_bin, bin_type=bt,
-                    use_missing=cfg.use_missing,
-                    zero_as_missing=cfg.zero_as_missing,
-                    forced_bounds=forced.get(f))
-                self.bin_mappers.append(m)
-            self.used_features = np.asarray(
-                [f for f, m in enumerate(self.bin_mappers)
-                 if not m.is_trivial], dtype=np.int32)
-            if len(self.used_features) == 0:
-                raise ValueError("Cannot construct Dataset: all features are "
-                                 "trivial (single value)")
-            self.max_num_bin = max(
-                self.bin_mappers[f].num_bin for f in self.used_features)
+            if sparse:
+                sample = np.asarray(sample.todense(), dtype=np.float64)
+            self._fit_mappers(sample, cat_idx, cfg)
 
         F = len(self.used_features)
-        dtype = np.uint8 if self.max_num_bin <= 256 else np.int32
-        self.bins = np.empty((self.num_data, F), dtype=dtype)
-        for j, f in enumerate(self.used_features):
-            self.bins[:, j] = self.bin_mappers[f].values_to_bins(
-                data[:, f]).astype(dtype)
+
+        if sparse:
+            # one CSR->CSC conversion; column slices are then O(nnz_col)
+            data_csc = data.tocsc()
+
+        def col_of(f):
+            if sparse:
+                return np.asarray(data_csc[:, [f]].todense(),
+                                  dtype=np.float64).ravel()
+            return data[:, f]
+
+        # -- EFB: pack mutually-exclusive sparse features (efb.py) ----
+        if self.reference is not None:
+            self.bundle_plan = self.reference.bundle_plan
+        elif cfg.enable_bundle and F > 4:
+            from .efb import plan_bundles
+            uf = self.used_features
+            sample_bins = np.stack(
+                [self.bin_mappers[f].values_to_bins(sample[:, f])
+                 for f in uf], axis=1)
+            plan = plan_bundles(
+                sample_bins,
+                [self.bin_mappers[f].num_bin for f in uf],
+                [self.bin_mappers[f].most_freq_bin for f in uf],
+                max_conflict_rate=cfg.max_conflict_rate,
+                max_bundle_bins=cfg.max_bundle_bins)
+            # bundle only when it genuinely shrinks the matrix
+            self.bundle_plan = (plan if plan.num_bundles <= int(0.75 * F)
+                                else None)
+        else:
+            self.bundle_plan = None
+
+        if self.bundle_plan is not None:
+            from .efb import encode_bundles
+
+            def cols():
+                for j, f in enumerate(self.used_features):
+                    yield j, self.bin_mappers[f].values_to_bins(
+                        col_of(f)).astype(np.int64)
+            self.bins = encode_bundles(self.bundle_plan, cols(),
+                                       self.num_data)
+        else:
+            dtype = np.uint8 if self.max_num_bin <= 256 else np.int32
+            self.bins = np.empty((self.num_data, F), dtype=dtype)
+            for j, f in enumerate(self.used_features):
+                self.bins[:, j] = self.bin_mappers[f].values_to_bins(
+                    col_of(f)).astype(dtype)
 
         if self.label is None and not self.params.get("_allow_no_label"):
             raise ValueError("Dataset has no label")
@@ -212,11 +266,145 @@ class Dataset:
                    else None)
         if self.config.linear_tree or (
                 ref_cfg is not None and ref_cfg.linear_tree):
+            if sparse:
+                raise ValueError(
+                    "linear_tree needs dense raw feature values; sparse "
+                    "input is not supported with linear trees")
             self.raw_values = np.ascontiguousarray(data, np.float32)
         if self.free_raw_data:
             self._raw_data = None
         self._constructed = True
         return self
+
+    def _construct_from_sequences(self) -> "Dataset":
+        """Two-round streaming load from Sequence objects: a sampled
+        pass fits BinMappers, then batches are binned row-block by
+        row-block — the full raw matrix never exists in memory
+        (basic.py _init_from_sample + _push_rows flow)."""
+        cfg = self.config
+        seqs = (self._raw_data if isinstance(self._raw_data, list)
+                else [self._raw_data])
+        lens = [len(s) for s in seqs]
+        self.num_data = int(sum(lens))
+        first = np.asarray(seqs[0][0], dtype=np.float64)
+        self.num_total_features = int(first.reshape(-1).shape[0])
+        if self.reference is not None:
+            ref = self.reference
+            if self.num_total_features != ref.num_total_features:
+                raise ValueError(
+                    f"validation data has {self.num_total_features} "
+                    f"features but training data has "
+                    f"{ref.num_total_features}")
+            self.bin_mappers = ref.bin_mappers
+            self.used_features = ref.used_features
+            self.max_num_bin = ref.max_num_bin
+            self.bundle_plan = ref.bundle_plan
+            names = list(ref.feature_name)
+        else:
+            names = [f"Column_{i}" for i in range(self.num_total_features)]
+        self.feature_name = names
+        cat_idx = self._resolve_categoricals(names)
+
+        starts = np.concatenate([[0], np.cumsum(lens)])
+
+        def fetch_rows(global_idx: np.ndarray) -> np.ndarray:
+            out = np.empty((len(global_idx), self.num_total_features))
+            for i, gi in enumerate(global_idx):
+                si = int(np.searchsorted(starts, gi, side="right") - 1)
+                out[i] = np.asarray(seqs[si][int(gi - starts[si])],
+                                    dtype=np.float64).reshape(-1)
+            return out
+
+        if self.reference is None:
+            sample_cnt = min(cfg.bin_construct_sample_cnt, self.num_data)
+            rng = np.random.RandomState(cfg.data_random_seed)
+            sample_idx = np.sort(rng.choice(self.num_data, sample_cnt,
+                                            replace=False))
+            sample = fetch_rows(sample_idx)
+            self._fit_mappers(sample, cat_idx, cfg)
+            self.bundle_plan = None  # streaming path stays unbundled
+
+        F = len(self.used_features)
+        if self.bundle_plan is not None:
+            # valid set against an EFB-bundled train set: encode into
+            # the same bundle layout so the trainer's decode matches
+            from .efb import encode_rows
+            dtype = (np.uint8 if self.bundle_plan.max_bundle_bins <= 256
+                     else np.int32)
+            self.bins = np.zeros(
+                (self.num_data, self.bundle_plan.num_bundles), dtype)
+        else:
+            dtype = np.uint8 if self.max_num_bin <= 256 else np.int32
+            self.bins = np.empty((self.num_data, F), dtype=dtype)
+        row0 = 0
+        for s in seqs:
+            bs = int(getattr(s, "batch_size", 4096) or 4096)
+            for lo in range(0, len(s), bs):
+                batch = np.asarray(s[lo:lo + bs], dtype=np.float64)
+                if batch.ndim == 1:
+                    batch = batch[None, :]
+                r = batch.shape[0]
+                batch_bins = np.empty((r, F), np.int64)
+                for j, f in enumerate(self.used_features):
+                    batch_bins[:, j] = self.bin_mappers[f].values_to_bins(
+                        batch[:, f])
+                if self.bundle_plan is not None:
+                    from .efb import encode_rows
+                    encode_rows(self.bundle_plan, batch_bins, self.bins,
+                                row0)
+                else:
+                    self.bins[row0:row0 + r] = batch_bins.astype(dtype)
+                row0 += r
+        assert row0 == self.num_data
+
+        if self.label is None and not self.params.get("_allow_no_label"):
+            raise ValueError("Dataset has no label")
+        if self.config.linear_tree:
+            raise ValueError(
+                "linear_tree needs dense raw feature values; Sequence "
+                "streaming input is not supported with linear trees")
+        self.raw_values = None
+        if self.free_raw_data:
+            self._raw_data = None
+        self._constructed = True
+        return self
+
+    def _fit_mappers(self, sample: np.ndarray, cat_idx: set, cfg) -> None:
+        """Fit per-feature BinMappers from a row sample
+        (ConstructBinMappersFromTextData / ConstructFromSampleData
+        analog), honoring max_bin_by_feature and forcedbins_filename
+        (dataset_loader.cpp:619-653)."""
+        mbf = list(cfg.max_bin_by_feature or [])
+        if mbf and len(mbf) != self.num_total_features:
+            raise ValueError(
+                f"max_bin_by_feature has {len(mbf)} entries but the "
+                f"dataset has {self.num_total_features} features")
+        forced: Dict[int, list] = {}
+        if cfg.forcedbins_filename:
+            import json as _json
+            with open(cfg.forcedbins_filename) as fh:
+                for item in _json.load(fh):
+                    forced[int(item["feature"])] = [
+                        float(x) for x in item["bin_upper_bound"]]
+        self.bin_mappers = []
+        for f in range(self.num_total_features):
+            bt = "categorical" if f in cat_idx else "numerical"
+            m = BinMapper.from_values(
+                sample[:, f],
+                max_bin=int(mbf[f]) if mbf else cfg.max_bin,
+                min_data_in_bin=cfg.min_data_in_bin, bin_type=bt,
+                use_missing=cfg.use_missing,
+                zero_as_missing=cfg.zero_as_missing,
+                forced_bounds=forced.get(f))
+            self.bin_mappers.append(m)
+        self.used_features = np.asarray(
+            [f for f, m in enumerate(self.bin_mappers)
+             if not m.is_trivial], dtype=np.int32)
+        if len(self.used_features) == 0:
+            raise ValueError("Cannot construct Dataset: all features are "
+                             "trivial (single value)")
+        self.max_num_bin = max(
+            self.bin_mappers[f].num_bin for f in self.used_features)
 
     def _resolve_categoricals(self, names) -> set:
         cat = self.categorical_feature
@@ -327,6 +515,11 @@ class Dataset:
             mapper_cats=np.concatenate(cats) if cats else np.empty(0,
                                                                    np.int64),
             mapper_cat_off=np.asarray(cat_off, np.int64))
+        if self.bundle_plan is not None:
+            fb, fo, fm, bnb, bscal = self.bundle_plan.state_arrays()
+            payload.update(efb_feat_bundle=fb, efb_feat_offset=fo,
+                           efb_feat_mfb=fm, efb_bundle_bins=bnb,
+                           efb_scalars=bscal)
         with open(filename, "wb") as f:
             np.savez_compressed(f, **payload)
         return self
@@ -355,6 +548,12 @@ class Dataset:
             scal = z["mapper_scalars"]
             ub, ub_off = z["mapper_ub"], z["mapper_ub_off"]
             cats, cat_off = z["mapper_cats"], z["mapper_cat_off"]
+            if "efb_scalars" in z:
+                from .efb import BundlePlan
+                self.bundle_plan = BundlePlan.from_state_arrays(
+                    z["efb_feat_bundle"], z["efb_feat_offset"],
+                    z["efb_feat_mfb"], z["efb_bundle_bins"],
+                    z["efb_scalars"])
         self.bin_mappers = [
             BinMapper.from_state_arrays(
                 scal[i], ub[ub_off[i]:ub_off[i + 1]],

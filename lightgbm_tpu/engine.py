@@ -338,6 +338,8 @@ class Booster:
                 data, num_features_hint=len(self._feature_names)).X
         if hasattr(data, "values") and hasattr(data, "columns"):
             data = data.values
+        if hasattr(data, "tocsr"):  # scipy sparse: densify for traversal
+            data = np.asarray(data.todense())
         arr = np.asarray(data, dtype=np.float64)
         if arr.ndim == 1:
             arr = arr[None, :]

@@ -39,7 +39,8 @@ def predict_bins_leaf(split_feature: jax.Array, threshold_bin: jax.Array,
                       default_left: jax.Array, is_cat: jax.Array,
                       left_child: jax.Array, right_child: jax.Array,
                       cat_bitset: jax.Array, nan_bin_pf: jax.Array,
-                      bins: jax.Array) -> jax.Array:
+                      bins: jax.Array,
+                      bundle_meta=None, num_bins_pf=None) -> jax.Array:
     """Node index where each binned row lands (NumericalDecision /
     CategoricalDecision walk of tree.h, vectorized over rows).
 
@@ -61,7 +62,17 @@ def predict_bins_leaf(split_feature: jax.Array, threshold_bin: jax.Array,
         feat = jnp.take(split_feature, node)
         internal = feat >= 0
         featc = jnp.maximum(feat, 0)
-        binv = row_feature_gather(bins, featc)
+        if bundle_meta is not None:
+            # EFB decode: bundle column -> this feature's own bin
+            from ..efb import decode_feature_bins
+            b_gof, b_off, b_mfb = bundle_meta
+            raw = row_feature_gather(bins, jnp.take(b_gof, featc))
+            binv = decode_feature_bins(
+                raw, jnp.take(b_off, featc),
+                jnp.take(num_bins_pf, featc), jnp.take(b_mfb, featc),
+                xp=jnp)
+        else:
+            binv = row_feature_gather(bins, featc)
         thr = jnp.take(threshold_bin, node)
         nb = jnp.take(nan_bin_pf, featc)
         isnan = (binv == nb) & (nb >= 0)
@@ -87,11 +98,12 @@ def predict_bins_leaf(split_feature: jax.Array, threshold_bin: jax.Array,
     return node
 
 
-def predict_bins_value(tree, nan_bin_pf: jax.Array,
-                       bins: jax.Array) -> jax.Array:
+def predict_bins_value(tree, nan_bin_pf: jax.Array, bins: jax.Array,
+                       bundle_meta=None, num_bins_pf=None) -> jax.Array:
     """Per-row unshrunk leaf output of one device tree ([R] f32)."""
     leaf_node = predict_bins_leaf(
         tree.split_feature, tree.threshold_bin, tree.default_left,
         tree.is_cat, tree.left_child, tree.right_child, tree.cat_bitset,
-        nan_bin_pf, bins)
+        nan_bin_pf, bins, bundle_meta=bundle_meta,
+        num_bins_pf=num_bins_pf)
     return jnp.take(tree.node_value, leaf_node)

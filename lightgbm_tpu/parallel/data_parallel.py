@@ -100,7 +100,8 @@ class DataParallelPlan:
                    valid_bins: Tuple[jax.Array, ...] = (),
                    valid_row_leaf0: Tuple[jax.Array, ...] = (),
                    mono_type_pf=None, interaction_groups=None,
-                   rng_key=None, feature_fraction_bynode: float = 1.0):
+                   rng_key=None, feature_fraction_bynode: float = 1.0,
+                   bundle_meta=None, bundle_bins: int = 0):
         return build_tree_dp(
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask, num_leaves=num_leaves,
@@ -112,7 +113,8 @@ class DataParallelPlan:
             mono_type_pf=mono_type_pf,
             interaction_groups=interaction_groups, rng_key=rng_key,
             feature_fraction_bynode=feature_fraction_bynode,
-            parallel_mode=self.parallel_mode, top_k=self.top_k)
+            parallel_mode=self.parallel_mode, top_k=self.top_k,
+            bundle_meta=bundle_meta, bundle_bins=bundle_bins)
 
 
 class VotingParallelPlan(DataParallelPlan):
@@ -248,13 +250,13 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "n_valid", "feature_fraction_bynode",
-                     "parallel_mode", "top_k"))
+                     "parallel_mode", "top_k", "bundle_bins"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl, block_rows,
                        n_valid, feature_fraction_bynode,
-                       parallel_mode="data", top_k=20):
+                       parallel_mode="data", top_k=20, bundle_bins=0):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
@@ -262,7 +264,7 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     def step(b, g, rl, nbpf, nanpf, catpf, fmask, vflat, extra):
         vbins = tuple(vflat[:n_valid])
         vrl = tuple(vflat[n_valid:])
-        mono, groups, key = extra
+        mono, groups, key, bmeta = extra
         return build_tree(
             b, g, rl, nbpf, nanpf, catpf, fmask,
             num_leaves=num_leaves, leaf_batch=leaf_batch,
@@ -273,7 +275,8 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             valid_bins=vbins, valid_row_leaf0=vrl,
             mono_type_pf=mono, interaction_groups=groups, rng_key=key,
             feature_fraction_bynode=feature_fraction_bynode,
-            parallel_mode=parallel_mode, top_k=top_k)
+            parallel_mode=parallel_mode, top_k=top_k,
+            bundle_meta=bmeta, bundle_bins=bundle_bins)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
@@ -302,7 +305,8 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   valid_row_leaf0: Tuple[jax.Array, ...] = (),
                   mono_type_pf=None, interaction_groups=None, rng_key=None,
                   feature_fraction_bynode: float = 1.0,
-                  parallel_mode: str = "data", top_k: int = 20):
+                  parallel_mode: str = "data", top_k: int = 20,
+                  bundle_meta=None, bundle_bins: int = 0):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
@@ -310,7 +314,7 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     returned row→leaf assignments stay row-sharded.
     """
     valid_flat = tuple(valid_bins) + tuple(valid_row_leaf0)
-    extras = (mono_type_pf, interaction_groups, rng_key)
+    extras = (mono_type_pf, interaction_groups, rng_key, bundle_meta)
     return _build_tree_dp_jit(
         mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
         feature_mask, valid_flat, extras, num_leaves=num_leaves,
@@ -320,4 +324,5 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             block_rows=block_rows,
         n_valid=len(valid_bins),
         feature_fraction_bynode=feature_fraction_bynode,
-        parallel_mode=parallel_mode, top_k=top_k)
+        parallel_mode=parallel_mode, top_k=top_k,
+        bundle_bins=bundle_bins)

@@ -1,0 +1,110 @@
+"""Exclusive Feature Bundling (feature_group.h analog, TPU layout)."""
+
+import numpy as np
+import pytest
+import scipy.sparse as sp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.efb import plan_bundles, encode_bundles
+
+
+def _sparse_data(rng, n=2000, dense_f=3, groups=3, per_group=10):
+    """A few dense columns + one-hot groups (the EFB sweet spot: columns
+    within a group are mutually exclusive by construction)."""
+    Xd = rng.normal(size=(n, dense_f))
+    blocks = []
+    for _ in range(groups):
+        hot = rng.randint(0, per_group, size=n)
+        blk = np.zeros((n, per_group))
+        blk[np.arange(n), hot] = rng.uniform(0.5, 2.0, size=n)
+        blocks.append(blk)
+    X = np.concatenate([Xd] + blocks, axis=1)
+    Xs = blocks[0]
+    y = (Xd[:, 0] + Xs[:, 0] * 2 - Xs[:, 1] + 0.1 * rng.normal(size=n)
+         > 0).astype(float)
+    return X, y
+
+
+def test_plan_bundles_packs_exclusive_features(rng):
+    S, F = 500, 12
+    bins = np.zeros((S, F), np.int64)
+    # features pairwise exclusive: feature f active on rows f mod 4
+    for f in range(F):
+        rows = np.arange(S) % 4 == (f % 4)
+        bins[rows, f] = 1 + (np.arange(S)[rows] % 3)
+    plan = plan_bundles(bins, [4] * F, [0] * F, max_conflict_rate=0.0,
+                        max_bundle_bins=64)
+    assert plan.num_bundles <= 4
+    # encode/decode round trip: every non-default bin recoverable
+    enc = encode_bundles(plan, ((f, bins[:, f]) for f in range(F)), S)
+    for f in range(F):
+        g, o = plan.feat_bundle[f], plan.feat_offset[f]
+        raw = enc[:, g].astype(np.int64)
+        dec = np.where((raw >= o) & (raw < o + 4), raw - o, 0)
+        np.testing.assert_array_equal(dec, bins[:, f])
+
+
+def test_efb_training_matches_unbundled(rng):
+    X, y = _sparse_data(rng)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 10, "max_bin": 16}
+    ds_b = lgb.Dataset(X, label=y, params=dict(params))
+    bst_b = lgb.train(dict(params), ds_b, 8)
+    assert ds_b.bundle_plan is not None, "bundling should trigger"
+    assert ds_b.bins.shape[1] < X.shape[1] // 2
+
+    ds_u = lgb.Dataset(X, label=y,
+                       params=dict(params, enable_bundle=False))
+    bst_u = lgb.train(dict(params, enable_bundle=False), ds_u, 8)
+    assert ds_u.bundle_plan is None
+
+    from sklearn.metrics import roc_auc_score
+    auc_b = roc_auc_score(y, bst_b.predict(X))
+    auc_u = roc_auc_score(y, bst_u.predict(X))
+    # zero-conflict bundling is (near-)lossless
+    assert auc_b > auc_u - 0.01, (auc_b, auc_u)
+    assert auc_b > 0.9
+
+
+def test_efb_scipy_sparse_input(rng):
+    X, y = _sparse_data(rng)
+    Xs = sp.csr_matrix(X)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "max_bin": 16}
+    ds = lgb.Dataset(Xs, label=y, params=params)
+    bst = lgb.train(params, ds, 8)
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, bst.predict(Xs)) > 0.9
+    # predictions from sparse and dense input agree
+    np.testing.assert_allclose(bst.predict(Xs), bst.predict(X),
+                               rtol=1e-6)
+
+
+def test_efb_valid_set_and_model_roundtrip(rng):
+    X, y = _sparse_data(rng)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "max_bin": 16}
+    ds = lgb.Dataset(X[:1500], label=y[:1500], params=dict(params))
+    vs = lgb.Dataset(X[1500:], label=y[1500:], reference=ds)
+    evals = {}
+    bst = lgb.train(dict(params), ds, 8, valid_sets=[vs],
+                    valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    assert evals["v"]["binary_logloss"][-1] < evals["v"]["binary_logloss"][0]
+    txt = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=txt)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X))
+
+
+def test_efb_binary_cache_roundtrip(tmp_path, rng):
+    X, y = _sparse_data(rng)
+    params = {"objective": "binary", "verbosity": -1, "max_bin": 16}
+    ds = lgb.Dataset(X, label=y, params=dict(params)).construct()
+    assert ds.bundle_plan is not None
+    path = str(tmp_path / "d.bin")
+    ds.save_binary(path)
+    ds2 = lgb.Dataset(path).construct()
+    assert ds2.bundle_plan is not None
+    np.testing.assert_array_equal(ds.bins, ds2.bins)
+    np.testing.assert_array_equal(ds.bundle_plan.feat_offset,
+                                  ds2.bundle_plan.feat_offset)
