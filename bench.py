@@ -108,9 +108,9 @@ def probe_hist_impl(platform: str) -> dict:
     rl = rng.randint(0, 2 * L, size=R).astype(np.int32)
     lids = np.arange(L, dtype=np.int32)
 
-    def bench_one(impl):
+    def bench_one(impl, leaf_ids=lids):
         fn = lambda: build_histograms(  # noqa: E731
-            bins, gh, rl, lids, num_bins=B, hist_dtype="bfloat16",
+            bins, gh, rl, leaf_ids, num_bins=B, hist_dtype="bfloat16",
             impl=impl)
         fn().block_until_ready()
         t0 = time.time()
@@ -130,6 +130,15 @@ def probe_hist_impl(platform: str) -> dict:
             out["hist_impl"] = "matmul"
         try:
             out["hist_matmul_ms"] = round(bench_one("matmul") * 1e3, 2)
+        except Exception:
+            pass
+        # histogram-subtraction ablation evidence: if doubling the leaf
+        # batch costs ~nothing (the matmul N dim pads to 128 anyway),
+        # building both children directly is free vs parent-minus-child
+        try:
+            lids2 = np.arange(2 * L, dtype=np.int32)
+            out["hist_ms_2x_leaves"] = round(
+                bench_one(out["hist_impl"], lids2) * 1e3, 2)
         except Exception:
             pass
     return out

@@ -37,6 +37,9 @@ class Booster:
                  model_str: Optional[str] = None):
         self.params = dict(params or {})
         self.best_iteration = -1
+        # bumped on every tree-set mutation; keys the packed-ensemble
+        # prediction cache (stale packs otherwise survive rollback+retrain)
+        self._model_version = 0
         self.best_score: Dict = {}
         self._valid_names: List[str] = []
         self._gbdt: Optional[GBDT] = None
@@ -152,6 +155,7 @@ class Booster:
     def update(self, train_set=None, fobj: Optional[Callable] = None) -> bool:
         """One boosting iteration; True if stopped (no more splits)."""
         self._ensure_gbdt()
+        self._model_version += 1
         if fobj is not None:
             if self._objective is not None:
                 raise ValueError(
@@ -177,6 +181,7 @@ class Booster:
         """Undo the newest iteration (LGBM_BoosterRollbackOneIter /
         gbdt.cpp:454)."""
         self._ensure_gbdt()
+        self._model_version += 1
         self._gbdt.rollback_one_iter()
         return self
 
@@ -314,9 +319,7 @@ class Booster:
             if self._average_output and use:
                 out /= len(use) // K
             return out
-        raw = np.zeros((X.shape[0], K))
-        for i, t in enumerate(use):
-            raw[:, (lo + i) % K] += t.predict(X)
+        raw = self._predict_raw_scores(X, use, lo, K)
         if self._average_output and use:
             raw /= len(use) // K
         if K == 1:
@@ -324,6 +327,51 @@ class Booster:
         if raw_score:
             return raw
         return self._converted(raw)
+
+    def _predict_raw_scores(self, X: np.ndarray, use, lo: int,
+                            K: int) -> np.ndarray:
+        """[n, K] raw scores. Large batches run the whole ensemble
+        on-device (ops/predict_ensemble — predictor.hpp's OpenMP batch
+        path, recast as a [rows, trees] lock-step walk); small ones and
+        linear trees take the host path."""
+        n = X.shape[0]
+        # NOTE contract divergence from the reference: the device path
+        # walks trees in float32 (X, thresholds, leaf values), the host
+        # path in float64 — a value within f32 eps of a threshold can
+        # route differently across the batch-size cutover. Per-class
+        # accumulation runs in f64 on both paths.
+        use_device = (len(use) > 0
+                      and not any(t.is_linear for t in use)
+                      and n * len(use) >= (1 << 16))
+        if not use_device:
+            raw = np.zeros((n, K))
+            for i, t in enumerate(use):
+                raw[:, (lo + i) % K] += t.predict(X)
+            return raw
+        import jax
+        import jax.numpy as jnp
+        from .ops.predict_ensemble import (pack_ensemble,
+                                           predict_raw_device)
+        key = (self._model_version, lo, lo + len(use))
+        if getattr(self, "_packed_key", None) != key:
+            self._packed = pack_ensemble(use)
+            self._packed_key = key
+        cls = np.asarray([(lo + i) % K for i in range(len(use))])
+        raw = np.zeros((n, K))
+        chunk = max(1024, (1 << 22) // max(len(use), 1))
+        for s0 in range(0, n, chunk):
+            Xc = X[s0:s0 + chunk]
+            pad = chunk - Xc.shape[0]
+            if pad > 0:  # keep ONE compiled shape across ragged tails
+                Xc = np.concatenate([Xc, np.zeros((pad, X.shape[1]))])
+            outs = np.asarray(predict_raw_device(
+                self._packed, jnp.asarray(Xc, jnp.float32)), np.float64)
+            if pad > 0:
+                outs = outs[:chunk - pad]
+            for k in range(K):
+                raw[s0:s0 + outs.shape[0], k] = \
+                    outs[:, cls == k].sum(axis=1)
+        return raw
 
     def _as_matrix(self, data) -> np.ndarray:
         if isinstance(data, Dataset):
@@ -475,6 +523,7 @@ class Booster:
         return ["none"] * (self._max_feature_idx + 1)
 
     def _load_from_string(self, s: str):
+        self._model_version += 1
         lines = s.splitlines()
         header: Dict[str, str] = {}
         i = 0
