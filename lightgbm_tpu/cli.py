@@ -142,10 +142,18 @@ def run(params: Dict[str, str]) -> int:
         return 0
 
     if task == "convert_model":
-        raise SystemExit(
-            "task=convert_model (if-else code generation, "
-            "gbdt_model_text.cpp:286) is not implemented; use "
-            "Booster.dump_model() for a JSON export")
+        from .codegen import model_to_c
+        model_in = _resolve_path(cfg.input_model, conf_dir)
+        booster = lgb.Booster(model_file=model_in)
+        code = model_to_c(booster._all_trees(),
+                          num_class=max(1, booster._num_class),
+                          objective=booster._objective_name,
+                          average_output=booster._average_output)
+        out_path = cfg.convert_model
+        with open(out_path, "w") as f:
+            f.write(code)
+        print(f"Converted model written to {out_path}")
+        return 0
 
     raise SystemExit(f"unknown task: {task!r}")
 

@@ -61,7 +61,18 @@ def _is_sparse(data) -> bool:
     return hasattr(data, "tocsc") and hasattr(data, "tocsr")
 
 
+def _is_arrow(data) -> bool:
+    return hasattr(data, "column_names") and hasattr(data, "num_rows")
+
+
 def _to_2d_float(data) -> np.ndarray:
+    if _is_arrow(data):
+        # pyarrow Table (arrow.h ArrowChunkedArray ingestion analog):
+        # column-wise conversion; chunked arrays concatenate
+        cols = [np.asarray(data.column(i).to_numpy(zero_copy_only=False),
+                           dtype=np.float64)
+                for i in range(data.num_columns)]
+        return np.ascontiguousarray(np.column_stack(cols))
     if hasattr(data, "values") and hasattr(data, "columns"):  # DataFrame
         arr = data.values
     else:
@@ -178,6 +189,8 @@ class Dataset:
 
         if isinstance(self.feature_name, (list, tuple)) and self.feature_name:
             names = list(self.feature_name)
+        elif _is_arrow(self._raw_data):
+            names = [str(c) for c in self._raw_data.column_names]
         elif hasattr(self._raw_data, "columns"):
             names = [str(c) for c in self._raw_data.columns]
         elif file_names and len(file_names) == self.num_total_features:
@@ -478,6 +491,49 @@ class Dataset:
 
     def __len__(self):
         return self.num_data
+
+    def subset(self, used_indices, params: Optional[Dict] = None
+               ) -> "Dataset":
+        """Row-subset view sharing this dataset's bin mappers
+        (Dataset::CopySubrow, dataset.cpp:836 / basic.py subset): the
+        child is already constructed — no re-binning."""
+        self.construct()
+        idx = np.sort(np.asarray(used_indices, np.int64))
+        child = Dataset.__new__(Dataset)
+        child.params = {**self.params, **(params or {})}
+        child.config = Config(child.params)
+        child._raw_data = None
+        child.feature_name = list(self.feature_name)
+        child.categorical_feature = self.categorical_feature
+        child.reference = self
+        child.free_raw_data = True
+        child.bin_mappers = self.bin_mappers
+        child.bundle_plan = self.bundle_plan
+        child.used_features = self.used_features
+        child.max_num_bin = self.max_num_bin
+        child.num_total_features = self.num_total_features
+        child.bins = self.bins[idx]
+        child.num_data = len(idx)
+        child.label = None if self.label is None else self.label[idx]
+        child.weight = None if self.weight is None else self.weight[idx]
+        child.init_score = None
+        if self.init_score is not None:
+            isc = np.asarray(self.init_score)
+            child.init_score = (isc[idx] if isc.ndim == 1
+                                else isc[idx, :])
+        child.group = None
+        if self.group is not None:
+            # rows of a query stay together or the subset is per-row;
+            # recompute sizes from membership (used_indices sorted)
+            bounds = self.query_boundaries()
+            qid = np.searchsorted(bounds, idx, side="right") - 1
+            change = np.nonzero(np.diff(qid))[0] + 1
+            child.group = np.diff(np.concatenate(
+                [[0], change, [len(idx)]])).astype(np.int64)
+        child.raw_values = (None if self.raw_values is None
+                            else self.raw_values[idx])
+        child._constructed = True
+        return child
 
     # ------------------------------------------------------------------
     # binary dataset cache (Dataset::SaveBinaryFile dataset.cpp:1018 /

@@ -359,6 +359,9 @@ class Booster:
         cls = np.asarray([(lo + i) % K for i in range(len(use))])
         raw = np.zeros((n, K))
         chunk = max(1024, (1 << 22) // max(len(use), 1))
+        # don't pad small batches to a huge canonical chunk — cap near n
+        # (multiple of 1024 keeps repeat batch sizes on one shape)
+        chunk = min(chunk, -(-n // 1024) * 1024)
         for s0 in range(0, n, chunk):
             Xc = X[s0:s0 + chunk]
             pad = chunk - Xc.shape[0]
@@ -384,14 +387,11 @@ class Booster:
             from .io import load_data_file
             data = load_data_file(
                 data, num_features_hint=len(self._feature_names)).X
-        if hasattr(data, "values") and hasattr(data, "columns"):
-            data = data.values
         if hasattr(data, "tocsr"):  # scipy sparse: densify for traversal
             data = np.asarray(data.todense())
-        arr = np.asarray(data, dtype=np.float64)
-        if arr.ndim == 1:
-            arr = arr[None, :]
-        return arr
+        # arrow Tables / DataFrames / arrays share the Dataset converter
+        from .dataset import _to_2d_float
+        return _to_2d_float(data)
 
     # -- model IO (gbdt_model_text.cpp analog) -------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,

@@ -79,3 +79,41 @@ def test_reference_binary_loads_our_model(tmp_path):
     a = np.loadtxt(tmp_path / "ours.txt")
     b = np.loadtxt(tmp_path / "refs.txt")
     np.testing.assert_allclose(a, b, rtol=0, atol=1e-12)
+
+
+def test_convert_model_c_code_matches_predictions(tmp_path, rng):
+    """task=convert_model emits C that g++ compiles; the compiled
+    predictor must reproduce our predictions exactly (f64 walk both
+    sides)."""
+    import lightgbm_tpu as lgb
+    X = np.random.RandomState(0).normal(size=(800, 5))
+    X[np.random.RandomState(1).rand(800, 5) < 0.05] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) ** 2 > 0.4)
+    ds = lgb.Dataset(X, label=y.astype(float))
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, ds, 5)
+    model = tmp_path / "m.txt"
+    bst.save_model(str(model))
+    r = _cli(["task=convert_model", f"input_model={model}",
+              "convert_model=model.c"], cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    # compile + drive the generated code
+    (tmp_path / "main.c").write_text(
+        '#include <stdio.h>\n#include <stdlib.h>\n#include <math.h>\n'
+        'void PredictRaw(const double*, double*);\n'
+        'int main(void){double f[5]; double out[1];\n'
+        '  while (scanf("%lf %lf %lf %lf %lf", f,f+1,f+2,f+3,f+4)==5){\n'
+        '    PredictRaw(f,out); printf("%.17g\\n", out[0]); }\n'
+        '  return 0;}\n')
+    cc = subprocess.run(["gcc", "-O1", "-o", "pred", "model.c", "main.c",
+                         "-lm"], cwd=str(tmp_path), capture_output=True,
+                        text=True)
+    assert cc.returncode == 0, cc.stderr[-2000:]
+    Xt = X[:100]
+    feed = "\n".join(" ".join("nan" if np.isnan(v) else repr(float(v))
+                              for v in row) for row in Xt)
+    run = subprocess.run(["./pred"], input=feed, cwd=str(tmp_path),
+                         capture_output=True, text=True)
+    got = np.asarray([float(x) for x in run.stdout.split()])
+    want = bst.predict(Xt, raw_score=True)
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)

@@ -127,3 +127,35 @@ def test_predict_on_file():
     pred = bst.predict(f"{EX}/binary_classification/binary.test")
     assert pred.shape == (500,)
     assert np.isfinite(pred).all()
+
+
+def test_arrow_table_input(rng):
+    pa = pytest.importorskip("pyarrow")
+    X = rng.normal(size=(500, 4))
+    y = (X[:, 0] > 0).astype(float)
+    tbl = pa.table({f"feat_{i}": X[:, i] for i in range(4)})
+    ds = lgb.Dataset(tbl, label=y)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, ds, 5)
+    assert bst.feature_name() == [f"feat_{i}" for i in range(4)]
+    b2 = lgb.train({"objective": "binary", "verbosity": -1,
+                    "num_leaves": 7}, lgb.Dataset(X, label=y), 5)
+    np.testing.assert_allclose(bst.predict(X), b2.predict(X), rtol=1e-6)
+
+
+def test_dataset_subset(rng):
+    X = rng.normal(size=(600, 5))
+    y = X[:, 0] + rng.normal(scale=0.1, size=600)
+    w = rng.uniform(0.5, 2, 600)
+    ds = lgb.Dataset(X, label=y, weight=w).construct()
+    idx = rng.choice(600, 200, replace=False)
+    sub = ds.subset(idx)
+    sidx = np.sort(idx)
+    assert sub.num_data == 200
+    np.testing.assert_array_equal(sub.bins, ds.bins[sidx])
+    np.testing.assert_array_equal(sub.label, ds.label[sidx])
+    np.testing.assert_array_equal(sub.weight, ds.weight[sidx])
+    # trains directly (no re-binning; shares mappers)
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "num_leaves": 7}, sub, 5)
+    assert np.isfinite(bst.predict(X[:10])).all()
