@@ -188,7 +188,11 @@ class GBDT:
             _pad_rows(np.asarray(w, np.float32), R))
 
         if objective is not None:
-            objective.init(lbl, w, self.train_set.query_boundaries())
+            okw = {}
+            if (objective.is_ranking
+                    and getattr(self.train_set, "position", None) is not None):
+                okw["position"] = self.train_set.position
+            objective.init(lbl, w, self.train_set.query_boundaries(), **okw)
             self._init_scores = np.asarray(objective.boost_from_score(),
                                            dtype=np.float64).reshape(-1)
             if len(self._init_scores) != self.K:
@@ -290,14 +294,27 @@ class GBDT:
 
         # quantized-gradient training (GradientDiscretizer,
         # gradient_discretizer.hpp:22/.cpp:55-140): gradients are
-        # stochastically rounded onto a {k*scale} grid. TPU-first
-        # realization: quantize-DEQUANTIZE — grid values flow through the
-        # same MXU histogram kernels and accumulate exactly in f32, so no
-        # separate int16/int32 histogram code path is needed; the
-        # information loss (and its regularization effect) matches the
-        # reference's int8 pipeline.
+        # stochastically rounded onto an int8 grid and the histogram runs
+        # as an int8 x int8 -> int32 MXU matmul (ops/histogram.py quant
+        # path — the analog of the packed int16/int32 histograms of
+        # cuda_histogram_constructor.cu, with the MXU's native int32
+        # accumulation replacing the per-leaf bit-width escalation).
+        # Split finding descales the tiny integer histogram once
+        # (FindBestThresholdInt, feature_histogram.hpp:177).
         self._quant = bool(config.use_quantized_grad)
         if self._quant:
+            nbq = int(config.num_grad_quant_bins)
+            if not 2 <= nbq <= 127:
+                raise ValueError(
+                    "num_grad_quant_bins must be in [2, 127] (int8 grid)")
+            # int32 accumulator bound: the hessian channel quantizes onto
+            # [0, nb] (hs = max|h|/nb), so a leaf's bin sum can reach
+            # rows * nb — the binding constraint (grads only reach nb/2)
+            if self.train_set.num_data * nbq >= 2 ** 31:
+                raise ValueError(
+                    "use_quantized_grad: num_data * num_grad_quant_bins "
+                    "overflows the int32 histogram accumulator; lower "
+                    "num_grad_quant_bins or shard rows over more chips")
             self._quant_key = jax.random.PRNGKey(
                 (int(config.data_random_seed) * 65537 + 17) & 0x7FFFFFFF)
             self._quantize_jit = jax.jit(self._quantize_impl)
@@ -395,11 +412,11 @@ class GBDT:
         method = self.config.monotone_constraints_method
         if method not in ("basic", "intermediate", "advanced"):
             raise ValueError(f"unknown monotone_constraints_method {method}")
-        if method != "basic":
+        if method == "advanced":
             raise NotImplementedError(
-                f"monotone_constraints_method={method} is not implemented "
-                "yet; use 'basic' (monotone_constraints.hpp:516,858 modes "
-                "are planned)")
+                "monotone_constraints_method=advanced is not implemented "
+                "yet; use 'basic' or 'intermediate' "
+                "(monotone_constraints.hpp:858)")
         return jnp.asarray(used)
 
     def _parse_interaction_constraints(self) -> Optional[jax.Array]:
@@ -560,7 +577,8 @@ class GBDT:
             return jnp.asarray(_pad_rows(a.T, R)).T
         return prep(gradients), prep(hessians)
 
-    def _build_one_tree(self, gh: jax.Array, fmask: jax.Array, k: int = 0):
+    def _build_one_tree(self, gh: jax.Array, fmask: jax.Array, k: int = 0,
+                        quant_scales: Optional[jax.Array] = None):
         """One tree on the current gradients; returns device results."""
         cfg = self.config
         builder = (self.plan.build_tree if self.plan is not None
@@ -572,6 +590,8 @@ class GBDT:
             jax.random.fold_in(self._tree_key, self.iter_), k)
             if self._tree_key is not None else None)
         kw = {}
+        if quant_scales is not None:
+            kw["quant_scales"] = quant_scales
         if self._bundle_meta is not None:
             kw["bundle_meta"] = self._bundle_meta
             kw["bundle_bins"] = self._bundle_bins
@@ -584,10 +604,19 @@ class GBDT:
                 t, ps, coupled, lazy = self._cegb
                 kw["cegb"] = (t, ps, coupled, lazy,
                               self._cegb_feat_used, self._cegb_used_rows)
+        mono_method = (cfg.monotone_constraints_method
+                       if self.mono_type_pf is not None else "basic")
+        leaf_batch = cfg.leaf_batch
+        if mono_method == "intermediate":
+            # cross-leaf bound propagation is only sound one split at a
+            # time (see tree_builder.py); the reference learner is
+            # sequential here anyway
+            leaf_batch = 1
+        kw["mono_method"] = mono_method
         out = builder(
             self.train_dd.bins, gh, self.train_dd.row_leaf0,
             self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
-            num_leaves=cfg.num_leaves, leaf_batch=cfg.leaf_batch,
+            num_leaves=cfg.num_leaves, leaf_batch=leaf_batch,
             max_depth=cfg.max_depth, num_bins=self.B,
             split_params=self.split_params,
             hist_dtype=cfg.hist_dtype, hist_impl=cfg.hist_impl,
@@ -604,8 +633,11 @@ class GBDT:
         return out
 
     def _quantize_impl(self, g, h, key):
-        """Stochastic rounding onto the quant grid (DiscretizeGradients,
-        gradient_discretizer.cpp:68-140). g, h: [K, R]."""
+        """Stochastic rounding onto the int8 quant grid
+        (DiscretizeGradients, gradient_discretizer.cpp:68-140).
+        g, h: [K, R] f32 -> int8 grid values [K, R] + per-class scales
+        (gs, hs) [K]. The int8 values feed the integer MXU histogram; the
+        scales descale histogram sums at split-find time."""
         cfg = self.config
         nb = int(cfg.num_grad_quant_bins)
         gs = jnp.maximum(jnp.max(jnp.abs(g), axis=1, keepdims=True),
@@ -622,7 +654,8 @@ class GBDT:
         # away from zero (gradient_discretizer.cpp:124-131)
         qg = jnp.trunc(g / gs + jnp.where(g >= 0, u1, -u1))
         qh = jnp.trunc(h / hs + u2)
-        return qg * gs, qh * hs
+        return (qg.astype(jnp.int8), qh.astype(jnp.int8),
+                gs[:, 0], hs[:, 0])
 
     def _renew_leaf_impl(self, tree_arrays: TreeArrays, row_leaf, g, h):
         """RenewIntGradTreeOutput (gradient_discretizer.cpp:208-258):
@@ -754,15 +787,22 @@ class GBDT:
         g, h, count_mask = self._sampling(self.iter_, g, h)
         g_true, h_true = g, h
         if self._quant:
-            g, h = self._quantize_jit(
+            qg, qh, q_gs, q_hs = self._quantize_jit(
                 g, h, jax.random.fold_in(self._quant_key, self.iter_))
+            count_i8 = count_mask.astype(jnp.int8)
 
         fmask = self._feature_mask()
         linear = bool(self.config.linear_tree)
         should_continue = False
         for k in range(self.K):
-            gh = jnp.stack([g[k], h[k], count_mask], axis=1)
-            tree_arrays, row_leaf, valid_rls = self._build_one_tree(gh, fmask, k)
+            if self._quant:
+                gh = jnp.stack([qg[k], qh[k], count_i8], axis=1)
+                qsk = {"quant_scales": jnp.stack([q_gs[k], q_hs[k]])}
+            else:
+                gh = jnp.stack([g[k], h[k], count_mask], axis=1)
+                qsk = {}
+            tree_arrays, row_leaf, valid_rls = self._build_one_tree(
+                gh, fmask, k, **qsk)
             if self._quant and bool(self.config.quant_train_renew_leaf):
                 tree_arrays = self._renew_jit(tree_arrays, row_leaf,
                                               g_true[k], h_true[k])

@@ -108,7 +108,7 @@ def _round_int(x):
     static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
                      "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "feature_fraction_bynode",
-                     "parallel_mode", "top_k", "bundle_bins"))
+                     "parallel_mode", "top_k", "bundle_bins", "mono_method"))
 def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
@@ -131,7 +131,9 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                gain_scale: Optional[jax.Array] = None,
                cegb: Optional[Tuple] = None,
                bundle_meta: Optional[Tuple] = None,
-               bundle_bins: int = 0):
+               bundle_bins: int = 0,
+               quant_scales: Optional[jax.Array] = None,
+               mono_method: str = "basic"):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
@@ -206,6 +208,29 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             return row_feature_gather(bmat, feat)
     sp = split_params
     use_mono = mono_type_pf is not None
+    # monotone_constraints_method=intermediate
+    # (IntermediateLeafConstraints, monotone_constraints.hpp:516): on a
+    # monotone split the children's output bounds tighten to the SIBLING's
+    # output (not the midpoint), and the new outputs propagate to every
+    # leaf whose region is adjacent along a monotone feature. The
+    # reference finds those leaves with recursive Go{Up,Down} tree walks
+    # approximated by the up-path's feature/threshold lists; here each
+    # leaf carries its bin-space bounding box [box_lo, box_hi] and
+    # adjacency is computed exactly and vectorized: two leaf boxes
+    # interact along monotone dim q iff they are separated along q and
+    # overlap in every other dim (disjoint boxes are separated along
+    # exactly one dim in that case). Exact geometry constrains strictly
+    # less than the reference's path approximation — same soundness,
+    # more admissible splits. Stale best-split caches (the reference
+    # recomputes them for `leaves_to_update_`) are instead handled by
+    # clamping cached outputs into the leaf's CURRENT bounds at apply
+    # time; cross-leaf propagation is only sound when splits apply one
+    # at a time, so callers force leaf_batch=1 in this mode.
+    use_mono_inter = use_mono and mono_method == "intermediate"
+    if use_mono_inter and leaf_batch != 1:
+        raise ValueError(
+            "monotone_constraints_method=intermediate requires "
+            "leaf_batch=1 (sequential split application)")
     use_inter = interaction_groups is not None
     use_bynode = feature_fraction_bynode < 1.0
     use_rand = bool(sp.extra_trees)
@@ -248,28 +273,42 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             "tree_learner=voting with sorted-subset categoricals is not "
             "supported; set max_cat_to_onehot high enough")
 
+    # quantized training: histograms come back int32 (exact); descale to
+    # (sum_g, sum_h, count) f32 once per build — the single-pass analog of
+    # FindBestThresholdInt's per-bin descale (feature_histogram.hpp:177).
+    # The [L, F, B, 3] result is tiny next to the R-sized matmul stream,
+    # so all the int8 bandwidth win of the hot loop is kept.
+    if quant_scales is not None:
+        _dq_vec = jnp.concatenate(
+            [quant_scales.astype(f32), jnp.ones((1,), f32)])
+
+    def _dequant(h):
+        if quant_scales is None:
+            return h
+        return h.astype(f32) * _dq_vec
+
     def hist_for(slots, rl):
         if mode == "feature":
             # local feature slice, all rows on-chip: no collective here
-            return build_histograms(
+            return _dequant(build_histograms(
                 local_bins, gh, rl, slots, num_bins=B,
                 block_rows=block_rows, axis_name=axis_name, merge=False,
-                hist_dtype=hist_dtype, impl=hist_impl)
+                hist_dtype=hist_dtype, impl=hist_impl))
         if mode == "voting":
             # local rows only; the merge happens per elected feature
-            return build_histograms(
+            return _dequant(build_histograms(
                 bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
                 axis_name=axis_name, merge=False,
-                hist_dtype=hist_dtype, impl=hist_impl)
+                hist_dtype=hist_dtype, impl=hist_impl))
         if use_bundle:
             hg = build_histograms(
                 bins, gh, rl, slots, num_bins=bundle_bins,
                 block_rows=block_rows, axis_name=axis_name,
                 hist_dtype=hist_dtype, impl=hist_impl)
-            return unbundle(hg)
-        return build_histograms(
+            return unbundle(_dequant(hg))
+        return _dequant(build_histograms(
             bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
-            axis_name=axis_name, hist_dtype=hist_dtype, impl=hist_impl)
+            axis_name=axis_name, hist_dtype=hist_dtype, impl=hist_impl))
 
     def _sync_best(bs):
         """Merge per-shard best splits by gain (SyncUpGlobalBestSplit)."""
@@ -467,6 +506,10 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                  leaf_lo=jnp.full((L + 1,), -F32_MAX, f32),
                  leaf_hi=jnp.full((L + 1,), F32_MAX, f32),
                  r=jnp.asarray(0, jnp.int32))
+    if use_mono_inter:
+        # inclusive bin-range box per leaf slot (feature space)
+        state["box_lo"] = jnp.zeros((L + 1, F), jnp.int32)
+        state["box_hi"] = jnp.full((L + 1, F), B - 1, jnp.int32)
     if use_inter:
         state["used_feat"] = jnp.zeros((L + 1, F), bool)
     if use_cegb:
@@ -547,6 +590,15 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         # (SplitInfo::left_output/right_output analog)
         lval = jnp.take(st["bs_lout"], sel_s)
         rval = jnp.take(st["bs_rout"], sel_s)
+        if use_mono_inter:
+            # stale-cache guard: neighbor propagation may have tightened
+            # this leaf's bounds after its split was cached; clamp into
+            # the CURRENT bounds (the reference instead recomputes best
+            # splits for every leaf in `leaves_to_update_`)
+            lo_s = jnp.take(st["leaf_lo"], sel_s)
+            hi_s = jnp.take(st["leaf_hi"], sel_s)
+            lval = jnp.clip(lval, lo_s, hi_s)
+            rval = jnp.clip(rval, lo_s, hi_s)
 
         # -- 2. record splits in node arrays
         t = t._replace(
@@ -577,7 +629,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         # monotone_constraints.hpp:488-504): numerical splits on constrained
         # features tighten children's bounds around the output midpoint
         leaf_lo, leaf_hi = st["leaf_lo"], st["leaf_hi"]
-        if use_mono:
+        new_state_mono = {}
+        if use_mono and not use_mono_inter:
             mid = (lval + rval) * 0.5
             mt_s = jnp.take(mono_type_pf, sfeat)
             upd = valid & (~scat) & (mt_s != 0)
@@ -591,6 +644,65 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                              .at[DUMMY_LEAF].set(-F32_MAX)
             leaf_hi = leaf_hi.at[sel_s].set(hi_l).at[right_slot].set(hi_r) \
                              .at[DUMMY_LEAF].set(F32_MAX)
+        if use_mono_inter:
+            # -- intermediate mode (module note above): maintain leaf
+            # boxes, then push the new outputs onto every adjacent leaf.
+            # The right child first CLONES the parent's accumulated
+            # bounds (entries_[new_leaf].reset(entries_[leaf]->clone()),
+            # monotone_constraints.hpp:548) — its region is a subset of
+            # the parent's, so every constraint on the parent applies.
+            lo_p = jnp.take(leaf_lo, sel_s)
+            hi_p = jnp.take(leaf_hi, sel_s)
+            leaf_lo = leaf_lo.at[right_slot].set(lo_p)
+            leaf_hi = leaf_hi.at[right_slot].set(hi_p)
+            box_lo, box_hi = st["box_lo"], st["box_hi"]
+            num_upd = (valid & ~scat)[:, None]                   # [W, 1]
+            par_lo = jnp.take(box_lo, sel_s, axis=0)             # [W, F]
+            par_hi = jnp.take(box_hi, sel_s, axis=0)
+            fone = jnp.arange(F, dtype=jnp.int32)[None, :] == sfeat[:, None]
+            l_hi = jnp.where(fone & num_upd,
+                             jnp.minimum(par_hi, sthr[:, None]), par_hi)
+            r_lo = jnp.where(fone & num_upd,
+                             jnp.maximum(par_lo, sthr[:, None] + 1), par_lo)
+            box_lo = box_lo.at[sel_s].set(par_lo).at[right_slot].set(r_lo)
+            box_hi = box_hi.at[sel_s].set(l_hi).at[right_slot].set(par_hi)
+            box_lo = box_lo.at[DUMMY_LEAF].set(0)
+            box_hi = box_hi.at[DUMMY_LEAF].set(B - 1)
+
+            # neighbor updates (GoUp/GoDownToFindLeavesToUpdate analog,
+            # monotone_constraints.hpp:624-805, exact-geometry form):
+            # for new leaf u and any live leaf v separated along exactly
+            # monotone dim q, v's output bound absorbs u's output.
+            # Covers the sibling too (separated along the split feature),
+            # which reproduces UpdateConstraintsWithOutputs (:545-558).
+            u_slots = jnp.concatenate([sel_s, right_slot])       # [2W]
+            u_out = jnp.concatenate([lval, rval])
+            u_ok = jnp.concatenate([valid, valid])
+            u_lo = jnp.take(box_lo, u_slots, axis=0)             # [2W, F]
+            u_hi = jnp.take(box_hi, u_slots, axis=0)
+            ovl = ((box_lo[None, :, :] <= u_hi[:, None, :])
+                   & (u_lo[:, None, :] <= box_hi[None, :, :]))   # [2W,L+1,F]
+            nno = jnp.sum(~ovl, axis=2)                          # [2W, L+1]
+            above = box_lo[None, :, :] > u_hi[:, None, :]
+            below = box_hi[None, :, :] < u_lo[:, None, :]
+            m_pos = (mono_type_pf > 0)[None, None, :]
+            m_neg = (mono_type_pf < 0)[None, None, :]
+            live = jnp.take(t.leaf2node, jnp.arange(L + 1)) != DUMMY_NODE
+            cond = ((nno == 1)[:, :, None] & (~ovl)
+                    & u_ok[:, None, None] & live[None, :, None])
+            raise_lo = (cond & ((above & m_pos) | (below & m_neg))) \
+                .any(axis=2)                                     # [2W, L+1]
+            drop_hi = (cond & ((below & m_pos) | (above & m_neg))) \
+                .any(axis=2)
+            leaf_lo = jnp.maximum(
+                leaf_lo, jnp.where(raise_lo, u_out[:, None], -F32_MAX)
+                .max(axis=0))
+            leaf_hi = jnp.minimum(
+                leaf_hi, jnp.where(drop_hi, u_out[:, None], F32_MAX)
+                .min(axis=0))
+            leaf_lo = leaf_lo.at[DUMMY_LEAF].set(-F32_MAX)
+            leaf_hi = leaf_hi.at[DUMMY_LEAF].set(F32_MAX)
+            new_state_mono = dict(box_lo=box_lo, box_hi=box_hi)
 
         # -- 2c. CEGB bookkeeping (UpdateLeafBestSplits): applied splits
         # mark their feature model-used (coupled) and their leaf's rows
@@ -691,7 +803,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                    bs_right=bs_right, bs_bits=bs_bits, bs_lout=bs_lout,
                    bs_rout=bs_rout,
                    leaf_depth=leaf_depth, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
-                   r=st["r"] + 1, **new_state_extra)
+                   r=st["r"] + 1, **new_state_extra, **new_state_mono)
         return out
 
     state = jax.lax.while_loop(cond, body, state)

@@ -423,11 +423,9 @@ class Config:
                 # linear per-row outputs would corrupt running scores
                 raise ValueError(
                     "linear_tree is not supported with boosting=dart")
-        if v.get("lambdarank_position_bias_regularization", 0.0):
-            raise NotImplementedError(
-                "lambdarank position bias learning (rank_objective.hpp:30 "
-                "+ .position files) is not implemented; unset "
-                "lambdarank_position_bias_regularization")
+        if v.get("lambdarank_position_bias_regularization", 0.0) < 0:
+            raise ValueError(
+                "lambdarank_position_bias_regularization must be >= 0")
         if self.objective in ("multiclass", "multiclassova") \
                 and self.num_class < 2:
             raise ValueError("num_class must be >= 2 for multiclass objective")

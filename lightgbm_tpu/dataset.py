@@ -93,7 +93,7 @@ class Dataset:
                  init_score=None, feature_name="auto",
                  categorical_feature="auto", params: Optional[Dict] = None,
                  reference: Optional["Dataset"] = None,
-                 free_raw_data: bool = True):
+                 free_raw_data: bool = True, position=None):
         self.params = dict(params or {})
         self.config = Config(self.params)
         self._raw_data = data
@@ -105,6 +105,10 @@ class Dataset:
             group, dtype=np.int64).reshape(-1)
         self.init_score = None if init_score is None else np.asarray(
             init_score, dtype=np.float64)
+        # per-row result positions for unbiased lambdarank
+        # (Metadata::positions, src/io/metadata.cpp; ids or names)
+        self.position = (None if position is None
+                         else np.asarray(position).reshape(-1))
         self.feature_name = feature_name
         self.categorical_feature = categorical_feature
         self.reference = reference
@@ -160,6 +164,8 @@ class Dataset:
                 self.group = loaded.group
             if self.init_score is None and loaded.init_score is not None:
                 self.init_score = loaded.init_score
+            if self.position is None and loaded.position is not None:
+                self.position = loaded.position
         sparse = _is_sparse(self._raw_data)
         if sparse:
             # scipy CSR/CSC input: binning samples densify per-row, full
@@ -486,6 +492,9 @@ class Dataset:
         elif name == "init_score":
             self.init_score = None if value is None else np.asarray(
                 value, dtype=np.float64)
+        elif name == "position":
+            self.position = (None if value is None
+                             else np.asarray(value).reshape(-1))
         else:
             raise ValueError(f"Unknown field {name}")
 
@@ -532,8 +541,57 @@ class Dataset:
                 [[0], change, [len(idx)]])).astype(np.int64)
         child.raw_values = (None if self.raw_values is None
                             else self.raw_values[idx])
+        child.position = (None if self.position is None
+                          else self.position[idx])
         child._constructed = True
         return child
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Append ``other``'s features to this dataset in place
+        (Dataset::AddFeaturesFrom, dataset.cpp:1586). Both datasets must
+        be constructed with the same ``num_data``; ``other``'s metadata
+        (label/weight/group) is discarded, matching the reference."""
+        self.construct()
+        other.construct()
+        if self.num_data != other.num_data:
+            raise ValueError(
+                f"cannot add features: num_data differs "
+                f"({self.num_data} vs {other.num_data})")
+        if self.bundle_plan is not None or other.bundle_plan is not None:
+            raise ValueError(
+                "add_features_from does not support EFB-bundled datasets "
+                "(set enable_bundle=false on both)")
+        if self.bins.dtype != other.bins.dtype:
+            wide = np.int32
+            self.bins = self.bins.astype(wide)
+            other_bins = other.bins.astype(wide)
+        else:
+            other_bins = other.bins
+        base = self.num_total_features
+        self.bins = np.concatenate([self.bins, other_bins], axis=1)
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self.used_features = np.concatenate(
+            [self.used_features, other.used_features + base])
+        # de-duplicate colliding names the way pandas would
+        names = list(self.feature_name)
+        taken = set(names)
+        for nm in other.feature_name:
+            new = nm
+            i = 1
+            while new in taken:
+                new = f"{nm}_{i}"
+                i += 1
+            taken.add(new)
+            names.append(new)
+        self.feature_name = names
+        self.num_total_features = base + other.num_total_features
+        self.max_num_bin = max(self.max_num_bin, other.max_num_bin)
+        if self.raw_values is not None and other.raw_values is not None:
+            self.raw_values = np.concatenate(
+                [self.raw_values, other.raw_values], axis=1)
+        else:
+            self.raw_values = None
+        return self
 
     # ------------------------------------------------------------------
     # binary dataset cache (Dataset::SaveBinaryFile dataset.cpp:1018 /
@@ -551,7 +609,8 @@ class Dataset:
             "max_num_bin": np.asarray(self.max_num_bin),
             "feature_name": np.asarray(self.feature_name),
         }
-        for field in ("label", "weight", "group", "init_score"):
+        for field in ("label", "weight", "group", "init_score",
+                      "position"):
             v = getattr(self, field)
             if v is not None:
                 payload[field] = v
@@ -598,7 +657,8 @@ class Dataset:
             self.used_features = z["used_features"]
             self.max_num_bin = int(z["max_num_bin"])
             self.feature_name = [str(s) for s in z["feature_name"]]
-            for field in ("label", "weight", "group", "init_score"):
+            for field in ("label", "weight", "group", "init_score",
+                          "position"):
                 if field in z and getattr(self, field) is None:
                     setattr(self, field, z[field])
             scal = z["mapper_scalars"]

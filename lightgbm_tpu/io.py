@@ -63,6 +63,7 @@ class LoadedFile:
     weight: Optional[np.ndarray] = None
     group: Optional[np.ndarray] = None  # per-query sizes
     init_score: Optional[np.ndarray] = None
+    position: Optional[np.ndarray] = None  # per-row position ids/names
     feature_names: List[str] = field(default_factory=list)
 
 
@@ -281,6 +282,13 @@ def load_data_file(path: str, config=None,
     init = _load_sidecar(path + ".init", np.float64)
     if init is not None:
         out.init_score = init
+    # .position sidecar (metadata.cpp positions; one id/name per row)
+    try:
+        with open(path + ".position", "r", encoding="utf-8") as f:
+            out.position = np.asarray(
+                [ln.strip() for ln in f if ln.strip()])
+    except OSError:
+        pass
     for ext in (".query", ".group"):
         q = _load_sidecar(path + ext, np.int64)
         if q is not None:

@@ -96,11 +96,26 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         scatter on cpu, matmul elsewhere). Both produce identical
         histograms up to f32 accumulation order.
 
-    Returns: [L, F, B, 3] float32.
+    Quantized mode (gradient_discretizer.hpp:22 + the packed int16/int32
+    histograms of cuda_histogram_constructor.cu): when ``gh`` is int8
+    (stochastically-rounded grid values from GBDT._quantize_impl), the
+    matmul runs as an int8 x int8 -> int32 MXU dot and the returned
+    histogram is **int32** — exact integer accumulation (deterministic
+    psum merge as a bonus). The caller descales the tiny [L, F, B, 3]
+    result once before split finding (FindBestThresholdInt,
+    feature_histogram.hpp:177, does the same descale during its bin
+    scan). The bandwidth win lands where it matters: the one-hot temp
+    drops bf16->int8 (2x) and gh f32->int8 (4x) in the R-sized hot
+    stream. int32 accumulation bounds: |sum| <= R_leaf * nb/2 — checked
+    host-side in GBDT (the analog of the reference's per-leaf
+    int16->int32 escalation, which the MXU makes unnecessary).
+
+    Returns: [L, F, B, 3] float32 (int32 when gh is int8).
     """
     R, F = bins.shape
     L = leaf_ids.shape[0]
     B = num_bins
+    quant = gh.dtype == jnp.int8
     if block_rows <= 0:
         block_rows = _pick_block_rows(R, F * B)
     if R % block_rows != 0:
@@ -126,6 +141,10 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
             hist = jax.lax.psum(hist, axis_name)
         return hist
 
+    # quantized addend/accumulator dtypes: int8 operands, exact int32 sums
+    adt = jnp.int8 if quant else cdt
+    acc_dt = jnp.int32 if quant else jnp.float32
+
     bins_b = bins.reshape(nb, block_rows, F)
     gh_b = gh.reshape(nb, block_rows, HIST_CH)
     leaf_b = row_leaf.reshape(nb, block_rows)
@@ -143,14 +162,17 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
             flat = ((li[:, None] * F + iota_f[None, :]) * B
                     + bb.astype(jnp.int32))              # [blk, F]
             # round addends exactly like the matmul path's cast chain
-            vals = ghb.astype(cdt).astype(jnp.float32)
+            if quant:
+                vals = ghb.astype(jnp.int32)
+            else:
+                vals = ghb.astype(cdt).astype(jnp.float32)
             vals = jnp.broadcast_to(
                 vals[:, None, :], (block_rows, F, HIST_CH))
             acc = acc.at[flat.reshape(-1)].add(
                 vals.reshape(block_rows * F, HIST_CH))
             return acc, None
 
-        acc0 = jnp.zeros(((L + 1) * F * B, HIST_CH), dtype=jnp.float32)
+        acc0 = jnp.zeros(((L + 1) * F * B, HIST_CH), dtype=acc_dt)
         if axis_name is not None:
             acc0 = _pvary(acc0, axis_name)
         acc, _ = jax.lax.scan(body_scatter, acc0, (bins_b, gh_b, leaf_b))
@@ -161,20 +183,21 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
 
     def body(acc, inputs):
         bb, ghb, lb = inputs
-        onehot = (bb.astype(jnp.int32)[:, :, None] == iota_b).astype(cdt)
+        onehot = (bb.astype(jnp.int32)[:, :, None] == iota_b).astype(adt)
         onehot = onehot.reshape(block_rows, F * B)
-        mask = (lb[:, None] == leaf_ids[None, :]).astype(cdt)
-        ghl = (mask[:, :, None] * ghb.astype(cdt)[:, None, :]).reshape(
+        mask = (lb[:, None] == leaf_ids[None, :]).astype(adt)
+        ghl = (mask[:, :, None] * ghb.astype(adt)[:, None, :]).reshape(
             block_rows, L * HIST_CH)
         # float32 mode must not silently drop to the MXU's bf16 passes
         prec = (jax.lax.Precision.HIGHEST if cdt == jnp.float32
                 else jax.lax.Precision.DEFAULT)
         acc = acc + jax.lax.dot(
-            onehot.T, ghl, precision=prec,
-            preferred_element_type=jnp.float32)
+            onehot.T, ghl,
+            precision=None if quant else prec,
+            preferred_element_type=acc_dt)
         return acc, None
 
-    acc0 = jnp.zeros((F * B, L * HIST_CH), dtype=jnp.float32)
+    acc0 = jnp.zeros((F * B, L * HIST_CH), dtype=acc_dt)
     if axis_name is not None:
         # inside shard_map the blocked inputs vary over the mapped axis;
         # the scan carry must carry the same varying-axis type

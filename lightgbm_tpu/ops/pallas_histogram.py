@@ -44,15 +44,17 @@ def pallas_available() -> bool:
 
 
 def _kernel(bins_ref, gh_ref, leaf_ref, lids_ref, out_ref, *,
-            num_bins: int, cdt, fb_pad: int, lb3_pad: int):
+            num_bins: int, cdt, fb_pad: int, lb3_pad: int, acc_dt):
     """One (feature-chunk, row-block) grid step.
 
     bins_ref: [blk, Fc] int32 (pre-padded; out-of-range bin == no match)
     gh_ref:   [blk, 8] f32   (grad, hess, in-bag count, 5 zero lanes)
+              — or int8 quantized grid values (see ops/histogram.py)
     leaf_ref: [blk, 8] int32 current leaf per row broadcast (-1 dead)
     lids_ref: [8, L_pad] int32 leaf slots this build targets (-2 pad)
-    out_ref:  [fb_pad, lb3_pad] f32 accumulator (same block every row
-              step; both dims padded to MXU/VPU tile multiples)
+    out_ref:  [fb_pad, lb3_pad] f32 (int32 when quantized) accumulator
+              (same block every row step; both dims padded to MXU/VPU
+              tile multiples)
     """
     j = pl.program_id(1)
     blk, fc = bins_ref.shape
@@ -76,7 +78,7 @@ def _kernel(bins_ref, gh_ref, leaf_ref, lids_ref, out_ref, *,
 
     part = jax.lax.dot_general(
         onehot, ghl, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)               # [fb_pad, lb3_pad]
+        preferred_element_type=acc_dt)                    # [fb_pad, lb3_pad]
 
     @pl.when(j == 0)
     def _():
@@ -121,6 +123,8 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
     Same contract: bins [R, F] uint/int, gh [R, 3] f32, row_leaf [R]
     int32, leaf_ids [L] int32 -> [L, F, B, 3] f32. R is padded up to the
     row block internally (padded rows get leaf -1).
+    int8 ``gh`` selects the quantized path (int8 MXU dot, exact int32
+    output — see ops/histogram.py docstring).
     ``interpret=True`` runs the kernel in the Pallas interpreter —
     CPU-testable parity with the real TPU lowering.
     """
@@ -129,7 +133,9 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
     R, F = bins.shape
     L = int(leaf_ids.shape[0])
     B = int(num_bins)
-    cdt = jnp.dtype(hist_dtype)
+    quant = gh.dtype == jnp.int8
+    cdt = jnp.int8 if quant else jnp.dtype(hist_dtype)
+    acc_dt = jnp.int32 if quant else jnp.float32
     blk, fc = _plan_chunks(F, B, L)
 
     r_pad = ((R + blk - 1) // blk) * blk
@@ -155,7 +161,7 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
 
     out = pl.pallas_call(
         functools.partial(_kernel, num_bins=B, cdt=cdt, fb_pad=fb_pad,
-                          lb3_pad=lb3_pad),
+                          lb3_pad=lb3_pad, acc_dt=acc_dt),
         grid=(n_fb, n_rb),
         in_specs=[
             pl.BlockSpec((blk, fc), lambda i, j: (j, i)),
@@ -165,7 +171,7 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
         ],
         out_specs=pl.BlockSpec((fb_pad, lb3_pad), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n_fb * fb_pad, lb3_pad),
-                                       jnp.float32),
+                                       acc_dt),
         interpret=interpret,
     )(bins.astype(jnp.int32), gh8, leaf8, lids8)
 

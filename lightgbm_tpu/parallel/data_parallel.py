@@ -101,7 +101,8 @@ class DataParallelPlan:
                    valid_row_leaf0: Tuple[jax.Array, ...] = (),
                    mono_type_pf=None, interaction_groups=None,
                    rng_key=None, feature_fraction_bynode: float = 1.0,
-                   bundle_meta=None, bundle_bins: int = 0):
+                   bundle_meta=None, bundle_bins: int = 0,
+                   quant_scales=None, mono_method: str = "basic"):
         return build_tree_dp(
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask, num_leaves=num_leaves,
@@ -114,7 +115,8 @@ class DataParallelPlan:
             interaction_groups=interaction_groups, rng_key=rng_key,
             feature_fraction_bynode=feature_fraction_bynode,
             parallel_mode=self.parallel_mode, top_k=self.top_k,
-            bundle_meta=bundle_meta, bundle_bins=bundle_bins)
+            bundle_meta=bundle_meta, bundle_bins=bundle_bins,
+            quant_scales=quant_scales, mono_method=mono_method)
 
 
 class VotingParallelPlan(DataParallelPlan):
@@ -162,7 +164,8 @@ class FeatureParallelPlan:
                    valid_bins: Tuple[jax.Array, ...] = (),
                    valid_row_leaf0: Tuple[jax.Array, ...] = (),
                    mono_type_pf=None, interaction_groups=None,
-                   rng_key=None, feature_fraction_bynode: float = 1.0):
+                   rng_key=None, feature_fraction_bynode: float = 1.0,
+                   quant_scales=None, mono_method: str = "basic"):
         if interaction_groups is not None or \
                 feature_fraction_bynode < 1.0 or split_params.extra_trees:
             raise NotImplementedError(
@@ -175,24 +178,27 @@ class FeatureParallelPlan:
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask,
             tuple(valid_bins) + tuple(valid_row_leaf0), mono_arr,
+            quant_scales,
             num_leaves=num_leaves, leaf_batch=leaf_batch,
             max_depth=max_depth, num_bins=num_bins,
             split_params=split_params, axis_name=self.axis_name,
             hist_dtype=hist_dtype, hist_impl=hist_impl,
             block_rows=block_rows, n_shards=self.num_shards,
-            has_mono=has_mono)
+            has_mono=has_mono, mono_method=mono_method)
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype",
-                     "hist_impl", "block_rows", "n_shards", "has_mono"))
+                     "hist_impl", "block_rows", "n_shards", "has_mono",
+                     "mono_method"))
 def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
-                       is_cat_pf, feature_mask, valid_flat, mono_arr, *,
+                       is_cat_pf, feature_mask, valid_flat, mono_arr,
+                       quant_scales, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl,
-                       block_rows, n_shards, has_mono):
+                       block_rows, n_shards, has_mono, mono_method="basic"):
     R, F = bins.shape
     # pad the feature axis so it splits evenly; pad features are trivial
     # (1 bin, masked out) and never selected
@@ -212,7 +218,7 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
 
     def step(b_full, b_loc, g, rl, nbpf, nanpf, catpf, fmask,
              loc_nbpf, loc_nanpf, loc_catpf, loc_fmask, loc_mono,
-             mono_full, vflat):
+             mono_full, vflat, qs):
         vbins = tuple(vflat[:n_valid])
         vrl = tuple(vflat[n_valid:])
         offset = (jax.lax.axis_index(axis_name)
@@ -228,21 +234,23 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             parallel_mode="feature", local_bins=b_loc,
             local_meta=(loc_nbpf, loc_nanpf, loc_catpf, loc_fmask,
                         loc_mono if has_mono else None),
-            feat_offset=offset)
+            feat_offset=offset, quant_scales=qs,
+            mono_method=mono_method)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
     valid_in_specs = tuple([rep] * (2 * n_valid))
+    qs_specs = jax.tree.map(lambda _: rep, quant_scales)
 
     fn = jax.shard_map(
         step, mesh=mesh,
         in_specs=(rep, fsh2, rep, rep, rep, rep, rep, rep,
-                  fsh, fsh, fsh, fsh, fsh, rep, valid_in_specs),
+                  fsh, fsh, fsh, fsh, fsh, rep, valid_in_specs, qs_specs),
         out_specs=(tree_specs, rep, tuple([rep] * n_valid)),
         check_vma=False)
     return fn(bins_p, bins_p, gh, row_leaf0, num_bins_p, nan_bin_p,
               is_cat_p, fmask_p, num_bins_p, nan_bin_p, is_cat_p, fmask_p,
-              mono_p, mono_p, valid_flat)
+              mono_p, mono_p, valid_flat, quant_scales)
 
 
 @functools.partial(
@@ -250,13 +258,15 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "n_valid", "feature_fraction_bynode",
-                     "parallel_mode", "top_k", "bundle_bins"))
+                     "parallel_mode", "top_k", "bundle_bins",
+                     "mono_method"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl, block_rows,
                        n_valid, feature_fraction_bynode,
-                       parallel_mode="data", top_k=20, bundle_bins=0):
+                       parallel_mode="data", top_k=20, bundle_bins=0,
+                       mono_method="basic"):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
@@ -264,7 +274,7 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     def step(b, g, rl, nbpf, nanpf, catpf, fmask, vflat, extra):
         vbins = tuple(vflat[:n_valid])
         vrl = tuple(vflat[n_valid:])
-        mono, groups, key, bmeta = extra
+        mono, groups, key, bmeta, qs = extra
         return build_tree(
             b, g, rl, nbpf, nanpf, catpf, fmask,
             num_leaves=num_leaves, leaf_batch=leaf_batch,
@@ -276,7 +286,8 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             mono_type_pf=mono, interaction_groups=groups, rng_key=key,
             feature_fraction_bynode=feature_fraction_bynode,
             parallel_mode=parallel_mode, top_k=top_k,
-            bundle_meta=bmeta, bundle_bins=bundle_bins)
+            bundle_meta=bmeta, bundle_bins=bundle_bins,
+            quant_scales=qs, mono_method=mono_method)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
@@ -306,7 +317,8 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   mono_type_pf=None, interaction_groups=None, rng_key=None,
                   feature_fraction_bynode: float = 1.0,
                   parallel_mode: str = "data", top_k: int = 20,
-                  bundle_meta=None, bundle_bins: int = 0):
+                  bundle_meta=None, bundle_bins: int = 0,
+                  quant_scales=None, mono_method: str = "basic"):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
@@ -314,7 +326,8 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     returned row→leaf assignments stay row-sharded.
     """
     valid_flat = tuple(valid_bins) + tuple(valid_row_leaf0)
-    extras = (mono_type_pf, interaction_groups, rng_key, bundle_meta)
+    extras = (mono_type_pf, interaction_groups, rng_key, bundle_meta,
+              quant_scales)
     return _build_tree_dp_jit(
         mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
         feature_mask, valid_flat, extras, num_leaves=num_leaves,
@@ -325,4 +338,4 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
         n_valid=len(valid_bins),
         feature_fraction_bynode=feature_fraction_bynode,
         parallel_mode=parallel_mode, top_k=top_k,
-        bundle_bins=bundle_bins)
+        bundle_bins=bundle_bins, mono_method=mono_method)

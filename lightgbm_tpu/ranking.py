@@ -39,12 +39,29 @@ def _build_query_index(query_boundaries: np.ndarray):
 class _RankingBase(Objective):
     is_ranking = True
 
-    def init(self, label, weight, query_boundaries=None):
+    def init(self, label, weight, query_boundaries=None, position=None):
         if query_boundaries is None:
             raise ValueError(
                 f"{self.name} objective requires query/group information")
         super().init(label, weight, query_boundaries)
         self.query_index = _build_query_index(np.asarray(query_boundaries))
+        # unbiased lambdarank positions (Metadata::positions): factorize
+        # arbitrary ids/names into [n] int32 indices + the id table
+        if position is not None:
+            position = np.asarray(position).reshape(-1)
+            if len(position) != len(label):
+                raise ValueError(
+                    f"positions has {len(position)} entries but the "
+                    f"dataset has {len(label)} rows (Metadata positions "
+                    "size check)")
+            self.position_ids, pos_idx = np.unique(
+                position, return_inverse=True)
+            self.positions = pos_idx.astype(np.int32)
+            self.num_position_ids = int(len(self.position_ids))
+        else:
+            self.position_ids = None
+            self.positions = None
+            self.num_position_ids = 0
 
     def scatter_from_queries(self, per_query, idx, num_rows):
         """[Q, S] -> [R]; each row appears in exactly one query slot."""
@@ -60,9 +77,17 @@ class LambdaRank(_RankingBase):
 
     name = "lambdarank"
 
-    def init(self, label, weight, query_boundaries=None):
-        super().init(label, weight, query_boundaries)
+    def init(self, label, weight, query_boundaries=None, position=None):
+        super().init(label, weight, query_boundaries, position)
         cfg = self.cfg
+        # position-bias factors (RankingObjective, rank_objective.hpp:30-68:
+        # pos_biases_ + learning_rate_ + position_bias_regularization_)
+        if self.num_position_ids:
+            self.pos_biases = jnp.zeros((self.num_position_ids,),
+                                        jnp.float32)
+            self._pb_lr = float(cfg.learning_rate)
+            self._pb_reg = float(
+                cfg.lambdarank_position_bias_regularization)
         max_label = int(np.max(label)) if len(label) else 0
         lg = list(cfg.label_gain)
         if not lg:
@@ -96,6 +121,12 @@ class LambdaRank(_RankingBase):
         y_q = jnp.where(idx >= 0, label[jnp.clip(idx, 0)].astype(jnp.int32),
                         -1)
         mask_q = idx >= 0
+        if self.num_position_ids:
+            # score_adjusted = score + pos_biases[position]
+            # (rank_objective.hpp:69-75)
+            pos = jnp.asarray(self.positions)
+            pos_q = jnp.where(idx >= 0, pos[jnp.clip(idx, 0)], 0)
+            s_q = jnp.where(mask_q, s_q + self.pos_biases[pos_q], s_q)
 
         def per_query(s, y, mask, inv):
             S = s.shape[0]
@@ -136,7 +167,27 @@ class LambdaRank(_RankingBase):
         h = self.scatter_from_queries(h_q, idx, R)
         if weight is not None:
             g, h = g * weight, h * weight
+        if self.num_position_ids:
+            self._update_position_bias(g, h)
         return g, h
+
+    def _update_position_bias(self, g, h):
+        """Newton-Raphson step on the per-position bias factors
+        (UpdatePositionBiasFactors, rank_objective.hpp:296-334):
+        d(utility)/d(bias_p) = -sum of lambdas at position p, minus L2
+        regularization scaled by the instance count. Runs eagerly once
+        per iteration; the segment sums are on-device."""
+        n = len(self.positions)
+        P = self.num_position_ids
+        pos = jnp.asarray(self.positions)
+        first = -jax.ops.segment_sum(g[:n], pos, num_segments=P)
+        second = -jax.ops.segment_sum(h[:n], pos, num_segments=P)
+        count = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), pos,
+                                    num_segments=P)
+        first = first - self.pos_biases * self._pb_reg * count
+        second = second - self._pb_reg * count
+        self.pos_biases = self.pos_biases + (
+            self._pb_lr * first / (jnp.abs(second) + 0.001))
 
 
 class RankXENDCG(_RankingBase):
@@ -144,8 +195,11 @@ class RankXENDCG(_RankingBase):
 
     name = "rank_xendcg"
 
-    def init(self, label, weight, query_boundaries=None):
-        super().init(label, weight, query_boundaries)
+    def init(self, label, weight, query_boundaries=None, position=None):
+        # positions are accepted but bias factors stay zero — the
+        # reference only learns them for lambdarank (the base-class
+        # UpdatePositionBiasFactors is a no-op, rank_objective.hpp:98)
+        super().init(label, weight, query_boundaries, position)
         self.seed = int(self.cfg.objective_seed)
 
     def get_gradients(self, score, label, weight, it=None):

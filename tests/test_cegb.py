@@ -90,6 +90,7 @@ def test_forced_bins(tmp_path, rng):
         assert np.any(np.isclose(ub, b)), (b, ub)
 
 
-def test_position_bias_param_raises():
-    with pytest.raises(NotImplementedError, match="position bias"):
-        lgb.Config({"lambdarank_position_bias_regularization": 0.5})
+def test_position_bias_param_validated():
+    lgb.Config({"lambdarank_position_bias_regularization": 0.5})
+    with pytest.raises(ValueError, match="position_bias"):
+        lgb.Config({"lambdarank_position_bias_regularization": -1.0})

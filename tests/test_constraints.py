@@ -68,10 +68,10 @@ def test_monotone_constraints_validation(rng):
         lgb.train({"objective": "regression",
                    "monotone_constraints": [1, -1], "verbosity": -1},
                   lgb.Dataset(X, label=y), 2)
-    with pytest.raises(NotImplementedError, match="intermediate"):
+    with pytest.raises(ValueError, match="unknown monotone"):
         lgb.train({"objective": "regression",
                    "monotone_constraints": [1, -1, 0],
-                   "monotone_constraints_method": "intermediate",
+                   "monotone_constraints_method": "nonsense",
                    "verbosity": -1},
                   lgb.Dataset(X, label=y), 2)
 
@@ -164,3 +164,62 @@ def test_path_smooth(rng):
     assert sp_smooth < sp_plain
     r2 = 1 - np.mean((smooth.predict(X) - y) ** 2) / np.var(y)
     assert r2 > 0.7
+
+
+def test_monotone_intermediate_enforced_and_better(rng):
+    """monotone_constraints_method=intermediate
+    (IntermediateLeafConstraints, monotone_constraints.hpp:516): must
+    stay monotone under the all-pair violation scan AND fit at least as
+    well as basic (it constrains strictly less — sibling-output bounds
+    instead of midpoints, exact box adjacency instead of path
+    approximation). Mirrors the reference test_engine.py
+    test_monotone_constraints method parametrization."""
+    X, y = _mono_data(rng)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 5}
+    fits = {}
+    for method in ("basic", "intermediate"):
+        bst = lgb.train({**params, "monotone_constraints_method": method},
+                        lgb.Dataset(X, label=y), 25)
+        assert _is_monotone(bst, X, 0, increasing=True), method
+        assert _is_monotone(bst, X, 1, increasing=False), method
+        fits[method] = np.mean((bst.predict(X) - y) ** 2)
+    assert fits["intermediate"] <= fits["basic"] * 1.001, fits
+
+
+def test_monotone_intermediate_with_penalty_and_depth(rng):
+    X, y = _mono_data(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "monotone_constraints": [1, -1, 0],
+                     "monotone_constraints_method": "intermediate",
+                     "monotone_penalty": 1.5, "max_depth": 4,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y), 15)
+    assert _is_monotone(bst, X, 0, increasing=True)
+    assert _is_monotone(bst, X, 1, increasing=False)
+
+
+def test_monotone_advanced_raises(rng):
+    X, y = _mono_data(rng, n=300)
+    with pytest.raises(NotImplementedError, match="advanced"):
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "monotone_constraints": [1, 0, 0],
+                   "monotone_constraints_method": "advanced"},
+                  lgb.Dataset(X, label=y), 2)
+
+
+def test_monotone_intermediate_deep_geometry(rng):
+    """Regression test: the right child must INHERIT the parent's
+    accumulated bounds (monotone_constraints.hpp:548 clone) — without it,
+    a leaf created two levels below a monotone split can emit outputs
+    that undercut a neighbor established earlier. Deep trees + a strong
+    non-monotone interaction maximize that geometry."""
+    n = 3000
+    X = rng.uniform(-1, 1, size=(n, 3))
+    y = (3 * X[:, 0] + 4 * np.sign(X[:, 1]) * X[:, 2] ** 2
+         + rng.normal(scale=0.1, size=n))
+    bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                     "verbosity": -1, "min_data_in_leaf": 3,
+                     "monotone_constraints": [1, 0, 0],
+                     "monotone_constraints_method": "intermediate"},
+                    lgb.Dataset(X, label=y), 30)
+    assert _is_monotone(bst, X, 0, increasing=True, grid=60)

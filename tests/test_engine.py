@@ -160,3 +160,32 @@ def test_exact_leafwise_matches_batched_reasonably(breast_cancer):
     a1 = roc_auc_score(y_te, b1.predict(X_te))
     a2 = roc_auc_score(y_te, b2.predict(X_te))
     assert abs(a1 - a2) < 0.02
+
+
+def test_add_features_from(breast_cancer):
+    """Dataset.add_features_from (Dataset::AddFeaturesFrom,
+    dataset.cpp:1586): horizontal concat of two constructed datasets."""
+    import numpy as np
+    X, _, y, _ = breast_cancer
+    half = X.shape[1] // 2
+    dA = lgb.Dataset(X[:, :half], label=y).construct()
+    dB = lgb.Dataset(X[:, half:],
+                     params={"_allow_no_label": True}).construct()
+    dA.add_features_from(dB)
+    assert dA.num_features == X.shape[1]
+    # colliding auto-names are deduplicated
+    assert len(set(dA.feature_name)) == len(dA.feature_name)
+    merged = lgb.train({"objective": "binary", "verbosity": -1,
+                        "num_leaves": 15}, dA, 10)
+    full = lgb.train({"objective": "binary", "verbosity": -1,
+                      "num_leaves": 15}, lgb.Dataset(X, label=y), 10)
+    from sklearn.metrics import roc_auc_score
+    a_m = roc_auc_score(y, merged.predict(X))
+    a_f = roc_auc_score(y, full.predict(X))
+    assert a_m > a_f - 0.01, (a_m, a_f)
+    # row-count mismatch is rejected
+    import pytest as _pytest
+    dC = lgb.Dataset(X[:100, half:],
+                     params={"_allow_no_label": True}).construct()
+    with _pytest.raises(ValueError, match="num_data"):
+        dA.add_features_from(dC)

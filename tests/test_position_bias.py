@@ -1,0 +1,87 @@
+"""Unbiased lambdarank: Metadata positions + position-bias factors
+(rank_objective.hpp:30-68 pos_biases_, :296-334
+UpdatePositionBiasFactors; reference test: test_engine.py
+test_ranking_with_position_information)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _click_data(rng, nq=120, per=10):
+    """Relevance drives clicks, attenuated by presentation position."""
+    n = nq * per
+    X = rng.normal(size=(n, 5))
+    rel = (X[:, 0] > 0.2).astype(int) + (X[:, 1] > 0.4).astype(int)
+    pos = np.tile(np.arange(per), nq)
+    p_obs = 1.0 / (1.0 + 0.7 * pos)          # position bias: top seen more
+    clicked = ((rel > 0) & (rng.rand(n) < p_obs)).astype(np.float64)
+    grp = np.full(nq, per)
+    return X, clicked, grp, pos
+
+
+def test_position_bias_factors_learn_decay(rng):
+    X, y, grp, pos = _click_data(rng)
+    ds = lgb.Dataset(X, label=y, group=grp, position=pos)
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "lambdarank_position_bias_regularization": 0.5},
+                    ds, 15)
+    biases = np.asarray(bst._gbdt.objective.pos_biases)
+    assert biases.shape == (10,)
+    # learned factors must mirror the synthetic bias: position 0 largest,
+    # decaying toward the tail (compare extremes, noise-tolerant)
+    assert biases[0] > biases[-1]
+    assert biases[:3].mean() > biases[-3:].mean()
+
+
+def test_position_bias_changes_model(rng):
+    X, y, grp, pos = _click_data(rng)
+    base = {"objective": "lambdarank", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    with_pos = lgb.train(base, lgb.Dataset(X, label=y, group=grp,
+                                           position=pos), 10)
+    without = lgb.train(base, lgb.Dataset(X, label=y, group=grp), 10)
+    assert not np.allclose(with_pos.predict(X), without.predict(X))
+
+
+def test_position_field_set_get_subset(rng):
+    X, y, grp, pos = _click_data(rng, nq=20)
+    ds = lgb.Dataset(X, label=y, group=grp)
+    ds.set_field("position", pos)
+    np.testing.assert_array_equal(ds.position, pos)
+    ds.construct()
+    sub = ds.subset(np.arange(50))
+    np.testing.assert_array_equal(sub.position, pos[:50])
+
+
+def test_position_binary_cache_roundtrip(rng, tmp_path):
+    X, y, grp, pos = _click_data(rng, nq=20)
+    ds = lgb.Dataset(X, label=y, group=grp, position=pos)
+    ds.construct()
+    f = str(tmp_path / "rank.bin")
+    ds.save_binary(f)
+    ds2 = lgb.Dataset(f)
+    ds2.construct()
+    np.testing.assert_array_equal(np.asarray(ds2.position, np.int64), pos)
+
+
+def test_position_sidecar_file(rng, tmp_path):
+    X, y, grp, pos = _click_data(rng, nq=10)
+    data = str(tmp_path / "rank.train")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.6f")
+    np.savetxt(data + ".query", grp, fmt="%d")
+    np.savetxt(data + ".position", pos, fmt="%d")
+    from lightgbm_tpu.io import load_data_file
+    loaded = load_data_file(data)
+    assert loaded.position is not None
+    np.testing.assert_array_equal(
+        loaded.position.astype(np.int64), pos)
+    # string position ids factorize too
+    names = np.asarray([f"slot_{p}" for p in pos])
+    from lightgbm_tpu.ranking import LambdaRank
+    obj = LambdaRank(lgb.Config({"objective": "lambdarank"}))
+    qb = np.concatenate([[0], np.cumsum(grp)])
+    obj.init(y, None, qb, position=names)
+    assert obj.num_position_ids == 10
