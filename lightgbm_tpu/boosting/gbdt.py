@@ -58,13 +58,18 @@ class _DeviceData:
     by jax.sharding instead of pre_partition'd files)."""
 
     def __init__(self, ds: Dataset, block: int, plan=None):
+        # num_data is PER-PROCESS under pre-partitioned multi-host
+        # loading (each host's Dataset holds its own row shard); r_pad is
+        # the GLOBAL padded row count, r_local this process's slice of it
         self.num_data = ds.num_data
         if plan is not None:
             self.r_pad = plan.pad_to(ds.num_data, block)
+            self.r_local = plan.local_rows(self.r_pad)
         else:
             self.r_pad = ((ds.num_data + block - 1) // block) * block
-        bins = _pad_rows(ds.bins, self.r_pad)
-        row_leaf0 = np.where(np.arange(self.r_pad) < ds.num_data, 0, -1) \
+            self.r_local = self.r_pad
+        bins = _pad_rows(ds.bins, self.r_local)
+        row_leaf0 = np.where(np.arange(self.r_local) < ds.num_data, 0, -1) \
             .astype(np.int32)
         if plan is not None:
             self.bins = plan.shard_rows(bins)
@@ -177,15 +182,37 @@ class GBDT:
         self.valid_sets = list(valid_sets)
 
         R = self.train_dd.r_pad
+        R_loc = self.train_dd.r_local
         lbl = self.train_set.get_label()
+        self._mp = bool(self.plan is not None
+                        and getattr(self.plan, "multi_process", False))
+        if self._mp and (bool(config.linear_tree)
+                         or init_row_scores is not None
+                         or self.train_set.get_init_score() is not None
+                         or (objective is not None
+                             and objective.is_ranking)):
+            # ranking: the padded-query index lattice holds LOCAL row
+            # ids; gathering from the global score array would read
+            # rank-0's rows on every host
+            raise NotImplementedError(
+                "multi-host training does not yet support linear_tree, "
+                "init_model continuation, Metadata init_score, or "
+                "ranking objectives")
 
         def _row_put(a):
             return (self.plan.shard_rows(a) if self.plan is not None
                     else jnp.asarray(a))
-        self.label_dev = _row_put(_pad_rows(np.asarray(lbl, np.float32), R))
+        self.label_dev = _row_put(
+            _pad_rows(np.asarray(lbl, np.float32), R_loc))
+        # global row count for GOSS's top-k over the global score sort
+        self._num_data_global = self.train_dd.num_data
+        if self._mp:
+            from jax.experimental import multihost_utils
+            self._num_data_global = int(multihost_utils.process_allgather(
+                np.asarray([self.train_dd.num_data], np.int64)).sum())
         w = self.train_set.get_weight()
         self.weight_dev = None if w is None else _row_put(
-            _pad_rows(np.asarray(w, np.float32), R))
+            _pad_rows(np.asarray(w, np.float32), R_loc))
 
         if objective is not None:
             okw = {}
@@ -197,6 +224,13 @@ class GBDT:
                                            dtype=np.float64).reshape(-1)
             if len(self._init_scores) != self.K:
                 self._init_scores = np.resize(self._init_scores, self.K)
+            if self._mp:
+                # per-process automatic init scores are averaged across
+                # hosts — Network::GlobalSyncUpByMean in BoostFromAverage
+                # (gbdt.cpp:313)
+                from ..parallel.distributed import global_mean_init_scores
+                self._init_scores = global_mean_init_scores(
+                    self._init_scores)
 
         if init_row_scores is not None:
             # continued training (init_model): scores resume from the
@@ -235,23 +269,28 @@ class GBDT:
                         jnp.zeros((self.K, dd.r_pad), jnp.float32))
             self._init_scores = np.zeros(self.K)
         else:
-            self.scores = jnp.zeros((self.K, R), jnp.float32)
-            if self.config.boost_from_average and objective is not None:
-                self.scores = self.scores + jnp.asarray(
-                    self._init_scores, jnp.float32)[:, None]
-                self._boosted_from_average = True
-            else:
+            if not (self.config.boost_from_average
+                    and objective is not None):
                 self._init_scores = np.zeros(self.K)
-            self.valid_scores = [
-                jnp.zeros((self.K, dd.r_pad), jnp.float32)
-                + (jnp.asarray(self._init_scores, jnp.float32)[:, None]
-                   if self._boosted_from_average else 0.0)
-                for dd in self.valid_dd]
+            else:
+                self._boosted_from_average = True
+            base = (self._init_scores.astype(np.float32)[:, None]
+                    if self._boosted_from_average else 0.0)
+
+            def _mk_scores(dd):
+                local = np.zeros((self.K, dd.r_local), np.float32) + base
+                return (self.plan.shard_scores(local)
+                        if self.plan is not None else jnp.asarray(local))
+            self.scores = _mk_scores(self.train_dd)
+            self.valid_scores = [_mk_scores(dd) for dd in self.valid_dd]
 
         # static metadata for the tree builder
-        self.num_bins_pf = jnp.asarray(self.train_set.per_feature_num_bins())
-        self.nan_bin_pf = jnp.asarray(self.train_set.per_feature_nan_bins())
-        self.is_cat_pf = jnp.asarray(
+        # multi-process jit rejects committed single-device inputs next
+        # to global-mesh arrays; plain numpy inputs are auto-replicated
+        _meta_put = np.asarray if self._mp else jnp.asarray
+        self.num_bins_pf = _meta_put(self.train_set.per_feature_num_bins())
+        self.nan_bin_pf = _meta_put(self.train_set.per_feature_nan_bins())
+        self.is_cat_pf = _meta_put(
             self.train_set.per_feature_is_categorical())
         self.split_params = SplitParams(
             lambda_l1=float(config.lambda_l1),
@@ -309,12 +348,14 @@ class GBDT:
                     "num_grad_quant_bins must be in [2, 127] (int8 grid)")
             # int32 accumulator bound: the hessian channel quantizes onto
             # [0, nb] (hs = max|h|/nb), so a leaf's bin sum can reach
-            # rows * nb — the binding constraint (grads only reach nb/2)
-            if self.train_set.num_data * nbq >= 2 ** 31:
+            # rows * nb — the binding constraint (grads only reach nb/2).
+            # GLOBAL rows: the per-shard int32 histograms are psum-merged
+            # in int32, so sharding does not relieve the bound.
+            if self._num_data_global * nbq >= 2 ** 31:
                 raise ValueError(
                     "use_quantized_grad: num_data * num_grad_quant_bins "
                     "overflows the int32 histogram accumulator; lower "
-                    "num_grad_quant_bins or shard rows over more chips")
+                    "num_grad_quant_bins")
             self._quant_key = jax.random.PRNGKey(
                 (int(config.data_random_seed) * 65537 + 17) & 0x7FFFFFFF)
             self._quantize_jit = jax.jit(self._quantize_impl)
@@ -477,7 +518,7 @@ class GBDT:
         sum_k |g*h|, sample `other_rate` of the rest, amplify their grads."""
         cfg = self.config
         R = g.shape[1]
-        n_real = self.train_dd.num_data
+        n_real = self._num_data_global
         real = (self.train_dd.row_leaf0 >= 0).astype(jnp.float32)
         # padded rows DO carry gradients (label 0 vs init score) — mask them
         # out of the ranking or they displace real rows from the top set
@@ -496,9 +537,11 @@ class GBDT:
         return g * scale[None, :], h * scale[None, :], mask
 
     def _sampling(self, it: int, g: jax.Array, h: jax.Array):
-        """Returns (g, h, count_mask [R] f32)."""
+        """Returns (g, h, count_mask [R] f32). Bagging masks are built
+        per process over local rows (the reference's bagging runs on
+        each machine's own partition too)."""
         cfg = self.config
-        R = self.train_dd.r_pad
+        R = self.train_dd.r_local
         real = self.train_dd.row_leaf0 >= 0
         base_mask = real.astype(jnp.float32)
         if self._goss:
@@ -545,7 +588,9 @@ class GBDT:
                     cnt = max(1, int(n * cfg.bagging_fraction))
                     idx = self._rng_bagging.choice(n, cnt, replace=False)
                     m[idx] = 1.0
-                self._bag_mask = jnp.asarray(m)
+                self._bag_mask = (self.plan.shard_rows(m)
+                                  if self.plan is not None
+                                  else jnp.asarray(m))
             mask = self._bag_mask
             return g * mask, h * mask, mask
         return g, h, base_mask
@@ -553,19 +598,23 @@ class GBDT:
     def _feature_mask(self) -> jax.Array:
         cfg = self.config
         F = self.train_set.num_features
+        put = np.asarray if self._mp else jnp.asarray
         if cfg.feature_fraction >= 1.0:
-            return jnp.ones((F,), bool)
+            return put(np.ones((F,), bool))
         k = max(1, int(F * cfg.feature_fraction))
         idx = self._rng_feature.choice(F, k, replace=False)
         m = np.zeros(F, bool)
         m[idx] = True
-        return jnp.asarray(m)
+        return put(m)
 
     # ------------------------------------------------------------------
     def _prep_custom_gh(self, gradients, hessians):
         """Custom fobj arrays: flat [K*num_data] class-major
-        (LGBM_BoosterUpdateOneIterCustom layout) or [num_data, K]."""
-        R = self.train_dd.r_pad
+        (LGBM_BoosterUpdateOneIterCustom layout) or [num_data, K].
+        Multi-host: the caller supplies THIS process's rows; placement
+        goes through the plan so the global array assembles from the
+        per-host blocks."""
+        R_loc = self.train_dd.r_local
 
         def prep(a):
             a = np.asarray(a, np.float32)
@@ -574,7 +623,9 @@ class GBDT:
                 a = a.reshape(self.K, n)
             else:
                 a = a.T
-            return jnp.asarray(_pad_rows(a.T, R)).T
+            kr = _pad_rows(a.T, R_loc).T
+            return (self.plan.shard_scores(kr) if self.plan is not None
+                    else jnp.asarray(kr))
         return prep(gradients), prep(hessians)
 
     def _build_one_tree(self, gh: jax.Array, fmask: jax.Array, k: int = 0,
@@ -947,13 +998,14 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_scores(self, which: int = -1) -> np.ndarray:
-        """Raw scores: which=-1 train, else valid index. [num_data, K]."""
-        if which < 0:
-            s = np.asarray(self.scores)[:, :self.train_dd.num_data]
-        else:
-            s = np.asarray(self.valid_scores[which]
-                           )[:, :self.valid_dd[which].num_data]
-        return s.T
+        """Raw scores: which=-1 train, else valid index. [num_data, K].
+        Multi-host: this process's rows only — per-machine metrics,
+        exactly the reference's distributed-learner behavior."""
+        dd = self.train_dd if which < 0 else self.valid_dd[which]
+        arr = self.scores if which < 0 else self.valid_scores[which]
+        if self.plan is not None:
+            return self.plan.host_local_cols(arr, dd.num_data).T
+        return np.asarray(arr)[:, :dd.num_data].T
 
     def current_iteration(self) -> int:
         return self.iter_

@@ -242,6 +242,12 @@ class Dataset:
         # -- EFB: pack mutually-exclusive sparse features (efb.py) ----
         if self.reference is not None:
             self.bundle_plan = self.reference.bundle_plan
+        elif self._multi_process_prepartition():
+            # pre-partitioned multi-host: a bundle plan built from the
+            # LOCAL sample would differ across hosts (different conflict
+            # counts -> different column layouts); skip EFB until the
+            # plan itself is synced like the mappers are
+            self.bundle_plan = None
         elif cfg.enable_bundle and F > 4:
             from .efb import plan_bundles
             uf = self.used_features
@@ -406,7 +412,20 @@ class Dataset:
                     forced[int(item["feature"])] = [
                         float(x) for x in item["bin_upper_bound"]]
         self.bin_mappers = []
+        # pre-partitioned multi-host: each process fits only its OWNED
+        # feature block (the reference fits len/num_machines features per
+        # machine, dataset_loader.cpp:1070); sync_bin_mappers fills the
+        # rest from the other hosts' blocks
+        owned = None
+        if self._sync_mappers_needed:
+            import jax
+            blocks = np.array_split(
+                np.arange(self.num_total_features), jax.process_count())
+            owned = set(int(f) for f in blocks[jax.process_index()])
         for f in range(self.num_total_features):
+            if owned is not None and f not in owned:
+                self.bin_mappers.append(BinMapper())  # filled by sync
+                continue
             bt = "categorical" if f in cat_idx else "numerical"
             m = BinMapper.from_values(
                 sample[:, f],
@@ -416,6 +435,14 @@ class Dataset:
                 zero_as_missing=cfg.zero_as_missing,
                 forced_bounds=forced.get(f))
             self.bin_mappers.append(m)
+        if self._sync_mappers_needed:
+            # pre-partitioned multi-host loading: every process holds a
+            # DIFFERENT row shard, so mappers fitted from local samples
+            # would disagree; merge the per-process feature blocks
+            # (ConstructBinMappersFromTextData's Allgather,
+            # dataset_loader.cpp:1070).
+            from .parallel.distributed import sync_bin_mappers
+            self.bin_mappers = sync_bin_mappers(self.bin_mappers)
         self.used_features = np.asarray(
             [f for f, m in enumerate(self.bin_mappers)
              if not m.is_trivial], dtype=np.int32)
@@ -443,6 +470,21 @@ class Dataset:
 
     # ------------------------------------------------------------------
     # accessors used by the trainer
+    def _multi_process_prepartition(self) -> bool:
+        """True when this Dataset is one shard of a multi-host
+        pre-partitioned load (bin mappers must be synced, EFB skipped)."""
+        if not bool(self.config.pre_partition):
+            return False
+        try:
+            import jax
+            return jax.process_count() > 1
+        except Exception:
+            return False
+
+    @property
+    def _sync_mappers_needed(self) -> bool:
+        return self._multi_process_prepartition()
+
     @property
     def num_features(self) -> int:
         return len(self.used_features)

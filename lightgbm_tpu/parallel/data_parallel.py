@@ -80,17 +80,69 @@ class DataParallelPlan:
         self.axis_name = axis_name
         self.num_shards = self.mesh.devices.size
         self.top_k = top_k
+        # multi-host: each process feeds its own pre-partitioned row
+        # shard (the rank/num_machines loading path of
+        # dataset_loader.cpp:203); device_put cannot address remote
+        # shards, so placement goes through
+        # jax.make_array_from_process_local_data instead.
+        self.num_processes = jax.process_count()
+        self.multi_process = self.num_processes > 1
 
     def pad_to(self, num_rows: int, block: int) -> int:
-        """Rows must divide evenly into shards × row-blocks."""
-        unit = block * self.num_shards
-        return ((num_rows + unit - 1) // unit) * unit
+        """GLOBAL padded row count. ``num_rows`` is this process's local
+        row count (they differ across hosts); every process pads its
+        shard to the same synced size so the global array is
+        rectangular."""
+        if not self.multi_process:
+            unit = block * self.num_shards
+            return ((num_rows + unit - 1) // unit) * unit
+        from jax.experimental import multihost_utils
+        d_local = self.num_shards // self.num_processes
+        unit = block * d_local
+        local_pad = ((num_rows + unit - 1) // unit) * unit
+        all_pads = multihost_utils.process_allgather(
+            np.asarray([local_pad], np.int64))
+        return int(all_pads.max()) * self.num_processes
+
+    def local_rows(self, r_pad: int) -> int:
+        """Rows this process contributes to a [r_pad, ...] global array."""
+        return r_pad // self.num_processes if self.multi_process else r_pad
 
     def shard_rows(self, arr):
-        return shard_rows(self.mesh, arr, self.axis_name)
+        """Place rows on the mesh. Single-process: ``arr`` is the full
+        array. Multi-process: ``arr`` is this process's LOCAL block of
+        ``local_rows(r_pad)`` rows."""
+        if not self.multi_process:
+            return shard_rows(self.mesh, arr, self.axis_name)
+        spec = P(self.axis_name, *([None] * (np.ndim(arr) - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), np.asarray(arr))
+
+    def shard_scores(self, local_kr):
+        """[K, local_rows] host block -> [K, r_pad] global, row axis 1."""
+        if not self.multi_process:
+            return jnp.asarray(local_kr)
+        spec = P(None, self.axis_name)
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, spec), np.asarray(local_kr))
+
+    def host_local_cols(self, arr, num_valid: int):
+        """[K, r_pad] global -> this process's [K, num_valid] host block
+        (the per-machine metric view of the reference's distributed
+        learners — each machine evaluates its own rows)."""
+        if not self.multi_process:
+            return np.asarray(arr)[:, :num_valid]
+        shards = [s for s in arr.addressable_shards]
+        shards.sort(key=lambda s: s.index[1].start or 0)
+        loc = np.concatenate([np.asarray(s.data) for s in shards], axis=1)
+        return loc[:, :num_valid]
 
     def replicate(self, arr):
-        return replicate(self.mesh, arr)
+        if not self.multi_process:
+            return replicate(self.mesh, arr)
+        # every process holds the identical full array by construction
+        return jax.make_array_from_process_local_data(
+            NamedSharding(self.mesh, P()), np.asarray(arr))
 
     def build_tree(self, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                    is_cat_pf, feature_mask, *, num_leaves: int,
@@ -145,13 +197,31 @@ class FeatureParallelPlan:
         self.axis_name = axis_name
         self.num_shards = self.mesh.devices.size
         self.top_k = top_k
+        self.num_processes = jax.process_count()
+        self.multi_process = self.num_processes > 1
+        if self.multi_process:
+            # feature-parallel assumes every worker holds ALL rows
+            # (feature_parallel_tree_learner.cpp model) — incompatible
+            # with per-process row shards
+            raise NotImplementedError(
+                "tree_learner=feature is single-host only; use "
+                "tree_learner=data for multi-host training")
 
     def pad_to(self, num_rows: int, block: int) -> int:
         return ((num_rows + block - 1) // block) * block
 
+    def local_rows(self, r_pad: int) -> int:
+        return r_pad
+
     def shard_rows(self, arr):
         # rows live whole on every chip
         return replicate(self.mesh, arr)
+
+    def shard_scores(self, local_kr):
+        return jnp.asarray(local_kr)
+
+    def host_local_cols(self, arr, num_valid: int):
+        return np.asarray(arr)[:, :num_valid]
 
     def replicate(self, arr):
         return replicate(self.mesh, arr)

@@ -21,9 +21,12 @@ Mapping of reference params (config.h network section):
 from __future__ import annotations
 
 import os
-from typing import Optional
+from typing import List, Optional
 
-__all__ = ["init_distributed", "maybe_init_distributed"]
+import numpy as np
+
+__all__ = ["init_distributed", "maybe_init_distributed",
+           "sync_bin_mappers", "global_mean_init_scores"]
 
 _initialized = False
 
@@ -69,3 +72,90 @@ def maybe_init_distributed(config) -> bool:
     init_distributed(coordinator_address=coordinator,
                      num_processes=n, process_id=process_id)
     return True
+
+
+def sync_bin_mappers(bin_mappers: List) -> List:
+    """Globally consistent bin mappers for pre-partitioned loading.
+
+    The reference's distributed loader
+    (``DatasetLoader::ConstructBinMappersFromTextData``,
+    ``dataset_loader.cpp:1070``) splits FEATURES into contiguous
+    per-machine blocks, has each machine find bins for its block from its
+    LOCAL sample, then ``Network::Allgather``s the serialized mappers so
+    every machine ends with the identical full set. Same protocol here:
+    each process serializes its owned block (``BinMapper.state_arrays``)
+    and a ``process_allgather`` over DCN merges them. Every process must
+    call this (it is a collective); returns the merged mapper list.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+
+    P = jax.process_count()
+    if P <= 1:
+        return bin_mappers
+    from ..binning import BinMapper
+    F = len(bin_mappers)
+    blocks = np.array_split(np.arange(F), P)
+    mine = blocks[jax.process_index()]
+
+    # serialize the owned block into flat arrays + offsets
+    scal, ubs, cats = [], [], []
+    ub_off, cat_off = [0], [0]
+    for f in mine:
+        s, ub, ct = bin_mappers[f].state_arrays()
+        scal.append(s)
+        ubs.append(ub)
+        cats.append(ct)
+        ub_off.append(ub_off[-1] + len(ub))
+        cat_off.append(cat_off[-1] + len(ct))
+    ns = len(scal[0]) if scal else 0
+    payload = np.concatenate([
+        np.asarray([len(mine), ns], np.float64),
+        np.asarray(ub_off, np.float64),
+        np.asarray(cat_off, np.float64),
+        np.concatenate(scal) if scal else np.empty(0),
+        np.concatenate(ubs) if ubs else np.empty(0),
+        (np.concatenate(cats) if cats else np.empty(0,
+                                                    np.int64)).astype(
+            np.float64),
+    ])
+    # pad to the max payload size so the allgather is rectangular
+    sizes = multihost_utils.process_allgather(
+        np.asarray([payload.size], np.int64))
+    maxlen = int(sizes.max())
+    buf = np.zeros(maxlen, np.float64)
+    buf[:payload.size] = payload
+    gathered = multihost_utils.process_allgather(buf)      # [P, maxlen]
+
+    merged: List = [None] * F
+    for p in range(P):
+        row = np.asarray(gathered[p])
+        nf, ns_p = int(row[0]), int(row[1])
+        pos = 2
+        ub_off_p = row[pos:pos + nf + 1].astype(np.int64)
+        pos += nf + 1
+        cat_off_p = row[pos:pos + nf + 1].astype(np.int64)
+        pos += nf + 1
+        scal_p = row[pos:pos + nf * ns_p].reshape(nf, ns_p)
+        pos += nf * ns_p
+        ub_p = row[pos:pos + ub_off_p[-1]]
+        pos += int(ub_off_p[-1])
+        cat_p = row[pos:pos + cat_off_p[-1]].astype(np.int64)
+        for j, f in enumerate(blocks[p]):
+            merged[f] = BinMapper.from_state_arrays(
+                scal_p[j], ub_p[ub_off_p[j]:ub_off_p[j + 1]],
+                cat_p[cat_off_p[j]:cat_off_p[j + 1]])
+    return merged
+
+
+def global_mean_init_scores(init_scores: np.ndarray) -> np.ndarray:
+    """Cross-process mean of the per-process automatic init scores —
+    exactly the reference's ``Network::GlobalSyncUpByMean(init_score)``
+    in BoostFromAverage (gbdt.cpp:313)."""
+    import jax
+    if jax.process_count() <= 1:
+        return init_scores
+    from jax.experimental import multihost_utils
+    allv = multihost_utils.process_allgather(
+        np.asarray(init_scores, np.float64))
+    return np.mean(allv, axis=0)

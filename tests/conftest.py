@@ -26,3 +26,8 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.RandomState(42)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running tests")
