@@ -161,11 +161,13 @@ class GBDT:
                         "voting": VotingParallelPlan}.get(
                             config.tree_learner, DataParallelPlan)
             if self._bundle_meta is not None and \
-                    plan_cls is not DataParallelPlan:
+                    plan_cls is FeatureParallelPlan:
+                # bundles mix features across the shard boundary; data
+                # and voting unbundle locally instead (tree_builder.py)
                 from .. import log as _log
                 _log.warning(
-                    "EFB-bundled datasets support data-parallel only; "
-                    "ignoring tree_learner=" + config.tree_learner)
+                    "EFB-bundled datasets do not support "
+                    "tree_learner=feature; using data-parallel")
                 plan_cls = DataParallelPlan
             self.plan = plan_cls(top_k=int(config.top_k))
             if self.plan.rows_sharded:
@@ -292,6 +294,24 @@ class GBDT:
         self.nan_bin_pf = _meta_put(self.train_set.per_feature_nan_bins())
         self.is_cat_pf = _meta_put(
             self.train_set.per_feature_is_categorical())
+        # sorted-subset categorical splits: features with more than
+        # max_cat_to_onehot bins leave the one-hot path
+        # (feature_histogram.cpp:172 `num_bin <= max_cat_to_onehot`)
+        self._cat_sorted_mask = None
+        _csm = (np.asarray(self.train_set.per_feature_is_categorical())
+                & (np.asarray(self.train_set.per_feature_num_bins())
+                   > int(config.max_cat_to_onehot)))
+        if _csm.any():
+            if self.plan is not None \
+                    and self.plan.parallel_mode == "voting":
+                from .. import log as _log
+                _log.warning(
+                    "tree_learner=voting does not support sorted-subset "
+                    "categorical splits; all categorical features use "
+                    "the one-hot path (raise max_cat_to_onehot to "
+                    "silence)")
+            else:
+                self._cat_sorted_mask = _meta_put(_csm)
         self.split_params = SplitParams(
             lambda_l1=float(config.lambda_l1),
             lambda_l2=float(config.lambda_l2),
@@ -643,6 +663,8 @@ class GBDT:
         kw = {}
         if quant_scales is not None:
             kw["quant_scales"] = quant_scales
+        if self._cat_sorted_mask is not None:
+            kw["cat_sorted_mask"] = self._cat_sorted_mask
         if self._bundle_meta is not None:
             kw["bundle_meta"] = self._bundle_meta
             kw["bundle_bins"] = self._bundle_bins

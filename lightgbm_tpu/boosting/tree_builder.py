@@ -252,26 +252,22 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 "the serial tree learner too)")
 
     mode = parallel_mode if axis_name is not None else "data"
-    if use_bundle and mode in ("feature", "voting"):
+    if use_bundle and mode == "feature":
         raise NotImplementedError(
-            "EFB-bundled datasets support serial/data tree learners only")
+            "EFB-bundled datasets do not compose with tree_learner="
+            "feature (bundles mix features across the shard boundary); "
+            "use serial/data/voting")
     if mode == "feature":
         if local_bins is None or local_meta is None or feat_offset is None:
             raise ValueError(
                 "feature-parallel needs local_bins/local_meta/feat_offset")
-        if use_inter or use_bynode or use_rand:
-            raise NotImplementedError(
-                "tree_learner=feature does not yet compose with "
-                "interaction constraints / per-node sampling / extra_trees")
-        if cat_sorted_mask is not None:
-            raise NotImplementedError(
-                "tree_learner=feature with sorted-subset categoricals is "
-                "not supported; set max_cat_to_onehot high enough")
         (loc_nbpf, loc_nanpf, loc_catpf, loc_fmask, loc_mono) = local_meta
+        F_loc = loc_nbpf.shape[0]
     if mode == "voting" and cat_sorted_mask is not None:
         raise NotImplementedError(
             "tree_learner=voting with sorted-subset categoricals is not "
-            "supported; set max_cat_to_onehot high enough")
+            "supported (the elected-subset split search needs per-slot "
+            "feature metadata); set max_cat_to_onehot high enough")
 
     # quantized training: histograms come back int32 (exact); descale to
     # (sum_g, sum_h, count) f32 once per build — the single-pass analog of
@@ -295,7 +291,17 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 block_rows=block_rows, axis_name=axis_name, merge=False,
                 hist_dtype=hist_dtype, impl=hist_impl))
         if mode == "voting":
-            # local rows only; the merge happens per elected feature
+            # local rows only; the merge happens per elected feature.
+            # EFB composes here: the bundle->feature unbundling is linear
+            # in the histogram, so unbundling LOCALLY commutes with the
+            # later psum of elected feature columns — votes and elections
+            # run in feature space, communication stays O(top_k * B).
+            if use_bundle:
+                hg = build_histograms(
+                    bins, gh, rl, slots, num_bins=bundle_bins,
+                    block_rows=block_rows, axis_name=axis_name,
+                    merge=False, hist_dtype=hist_dtype, impl=hist_impl)
+                return unbundle(_dequant(hg))
             return _dequant(build_histograms(
                 bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
                 axis_name=axis_name, merge=False,
@@ -402,12 +408,29 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         gain_penalty = (cegb_penalty_for(slots_c, rl, t, state)
                         if use_cegb else None)
         if mode == "feature":
-            # split search over this chip's feature slice only
+            # split search over this chip's feature slice only.
+            # Interaction constraints / per-node sampling / extra-trees
+            # compose by slicing the GLOBAL per-slot mask at this chip's
+            # window: the constraint state and PRNG are replicated, so
+            # every chip computes the identical global mask and takes
+            # its block (the reference composes the same way via the
+            # ColSampler living inside each templated learner,
+            # tree_learner.cpp:15-57).
+            S = slots_c.shape[0]
+            fmask_loc = jax.lax.dynamic_slice(
+                fmask_s, (0, feat_offset), (S, F_loc)) & loc_fmask[None, :]
+            rand_loc = (jax.lax.dynamic_slice(
+                rand_bin, (0, feat_offset), (S, F_loc))
+                if rand_bin is not None else None)
+            cs_loc = (jax.lax.dynamic_slice(
+                cat_sorted_mask, (feat_offset,), (F_loc,))
+                if cat_sorted_mask is not None else None)
             bs = find_best_splits(
                 hist2w, loc_nbpf, loc_nanpf, loc_catpf, sp,
-                feature_mask=loc_fmask, mono_type=loc_mono,
+                feature_mask=fmask_loc, mono_type=loc_mono,
                 leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
-                slot_depth=slot_depth)
+                slot_depth=slot_depth, rand_bin=rand_loc,
+                cat_sorted_mask=cs_loc)
             bs["feature"] = bs["feature"] + feat_offset
         elif mode == "voting":
             S = slots_c.shape[0]

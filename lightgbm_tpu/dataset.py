@@ -143,6 +143,11 @@ class Dataset:
             # binary dataset cache (LoadFromBinFile analog): restores
             # the constructed state directly, no parsing or re-binning
             self._load_binary(self._raw_data)
+            sl = self._auto_partition_slice(self.bins.shape[0])
+            if sl is not None:
+                self.bins = self.bins[sl]
+                self.num_data = len(sl)
+                self._apply_partition(sl)
             if self.label is None and not self.params.get("_allow_no_label"):
                 raise ValueError("Dataset has no label")
             return self
@@ -190,6 +195,10 @@ class Dataset:
                     f"validation data has {data.shape[1]} features but "
                     f"training data has "
                     f"{self.reference.num_total_features}")
+        sl = self._auto_partition_slice(data.shape[0])
+        if sl is not None:
+            data = data[sl]
+            self._apply_partition(sl)
         self.num_data, self.num_total_features = data.shape
         cfg = self.config
 
@@ -242,7 +251,7 @@ class Dataset:
         # -- EFB: pack mutually-exclusive sparse features (efb.py) ----
         if self.reference is not None:
             self.bundle_plan = self.reference.bundle_plan
-        elif self._multi_process_prepartition():
+        elif self._multi_process():
             # pre-partitioned multi-host: a bundle plan built from the
             # LOCAL sample would differ across hosts (different conflict
             # counts -> different column layouts); skip EFB until the
@@ -307,6 +316,10 @@ class Dataset:
         row-block — the full raw matrix never exists in memory
         (basic.py _init_from_sample + _push_rows flow)."""
         cfg = self.config
+        if self._multi_process() and not bool(cfg.pre_partition):
+            raise NotImplementedError(
+                "multi-host Sequence ingestion requires pre-partitioned "
+                "sequences per host (pre_partition=true)")
         seqs = (self._raw_data if isinstance(self._raw_data, list)
                 else [self._raw_data])
         lens = [len(s) for s in seqs]
@@ -419,8 +432,9 @@ class Dataset:
         owned = None
         if self._sync_mappers_needed:
             import jax
-            blocks = np.array_split(
-                np.arange(self.num_total_features), jax.process_count())
+            from .parallel.distributed import feature_blocks
+            blocks = feature_blocks(self.num_total_features,
+                                    jax.process_count())
             owned = set(int(f) for f in blocks[jax.process_index()])
         for f in range(self.num_total_features):
             if owned is not None and f not in owned:
@@ -470,11 +484,9 @@ class Dataset:
 
     # ------------------------------------------------------------------
     # accessors used by the trainer
-    def _multi_process_prepartition(self) -> bool:
-        """True when this Dataset is one shard of a multi-host
-        pre-partitioned load (bin mappers must be synced, EFB skipped)."""
-        if not bool(self.config.pre_partition):
-            return False
+    def _multi_process(self) -> bool:
+        """True under a multi-host runtime: this Dataset holds (or will
+        hold) one row shard — bin mappers must be synced, EFB skipped."""
         try:
             import jax
             return jax.process_count() > 1
@@ -483,7 +495,33 @@ class Dataset:
 
     @property
     def _sync_mappers_needed(self) -> bool:
-        return self._multi_process_prepartition()
+        return self._multi_process()
+
+    def _auto_partition_slice(self, n: int):
+        """Rows this process keeps when the caller did NOT pre-partition:
+        the loader's rank/num_machines row split
+        (DatasetLoader::LoadFromFile, dataset_loader.cpp:203). With
+        pre_partition=true the caller's data is already this host's
+        shard and no slicing happens."""
+        if not self._multi_process() or bool(self.config.pre_partition):
+            return None
+        if self.group is not None:
+            raise NotImplementedError(
+                "multi-host auto-partition does not support query/group "
+                "data; pre-partition queries per host and set "
+                "pre_partition=true")
+        import jax
+        from .parallel.distributed import feature_blocks as _blocks
+        return _blocks(n, jax.process_count())[jax.process_index()]
+
+    def _apply_partition(self, sl) -> None:
+        for fld in ("label", "weight", "position"):
+            v = getattr(self, fld)
+            if v is not None:
+                setattr(self, fld, v[sl])
+        if self.init_score is not None:
+            isc = np.asarray(self.init_score)
+            self.init_score = isc[sl] if isc.ndim == 1 else isc[sl, :]
 
     @property
     def num_features(self) -> int:

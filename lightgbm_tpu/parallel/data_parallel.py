@@ -154,7 +154,8 @@ class DataParallelPlan:
                    mono_type_pf=None, interaction_groups=None,
                    rng_key=None, feature_fraction_bynode: float = 1.0,
                    bundle_meta=None, bundle_bins: int = 0,
-                   quant_scales=None, mono_method: str = "basic"):
+                   quant_scales=None, mono_method: str = "basic",
+                   cat_sorted_mask=None):
         return build_tree_dp(
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask, num_leaves=num_leaves,
@@ -168,7 +169,8 @@ class DataParallelPlan:
             feature_fraction_bynode=feature_fraction_bynode,
             parallel_mode=self.parallel_mode, top_k=self.top_k,
             bundle_meta=bundle_meta, bundle_bins=bundle_bins,
-            quant_scales=quant_scales, mono_method=mono_method)
+            quant_scales=quant_scales, mono_method=mono_method,
+            cat_sorted_mask=cat_sorted_mask)
 
 
 class VotingParallelPlan(DataParallelPlan):
@@ -235,12 +237,8 @@ class FeatureParallelPlan:
                    valid_row_leaf0: Tuple[jax.Array, ...] = (),
                    mono_type_pf=None, interaction_groups=None,
                    rng_key=None, feature_fraction_bynode: float = 1.0,
-                   quant_scales=None, mono_method: str = "basic"):
-        if interaction_groups is not None or \
-                feature_fraction_bynode < 1.0 or split_params.extra_trees:
-            raise NotImplementedError(
-                "tree_learner=feature does not yet compose with "
-                "interaction constraints / per-node sampling / extra_trees")
+                   quant_scales=None, mono_method: str = "basic",
+                   cat_sorted_mask=None):
         has_mono = mono_type_pf is not None
         mono_arr = (mono_type_pf if has_mono
                     else jnp.zeros_like(num_bins_pf))
@@ -248,13 +246,14 @@ class FeatureParallelPlan:
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask,
             tuple(valid_bins) + tuple(valid_row_leaf0), mono_arr,
-            quant_scales,
+            (quant_scales, interaction_groups, rng_key, cat_sorted_mask),
             num_leaves=num_leaves, leaf_batch=leaf_batch,
             max_depth=max_depth, num_bins=num_bins,
             split_params=split_params, axis_name=self.axis_name,
             hist_dtype=hist_dtype, hist_impl=hist_impl,
             block_rows=block_rows, n_shards=self.num_shards,
-            has_mono=has_mono, mono_method=mono_method)
+            has_mono=has_mono, mono_method=mono_method,
+            feature_fraction_bynode=feature_fraction_bynode)
 
 
 @functools.partial(
@@ -262,13 +261,14 @@ class FeatureParallelPlan:
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype",
                      "hist_impl", "block_rows", "n_shards", "has_mono",
-                     "mono_method"))
+                     "mono_method", "feature_fraction_bynode"))
 def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, mono_arr,
-                       quant_scales, *,
+                       fp_extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl,
-                       block_rows, n_shards, has_mono, mono_method="basic"):
+                       block_rows, n_shards, has_mono, mono_method="basic",
+                       feature_fraction_bynode=1.0):
     R, F = bins.shape
     # pad the feature axis so it splits evenly; pad features are trivial
     # (1 bin, masked out) and never selected
@@ -288,9 +288,10 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
 
     def step(b_full, b_loc, g, rl, nbpf, nanpf, catpf, fmask,
              loc_nbpf, loc_nanpf, loc_catpf, loc_fmask, loc_mono,
-             mono_full, vflat, qs):
+             mono_full, vflat, extra):
         vbins = tuple(vflat[:n_valid])
         vrl = tuple(vflat[n_valid:])
+        qs, groups, key, csm = extra
         offset = (jax.lax.axis_index(axis_name)
                   * jnp.int32(b_loc.shape[1]))
         return build_tree(
@@ -301,26 +302,38 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             hist_dtype=hist_dtype, hist_impl=hist_impl,
             block_rows=block_rows, valid_bins=vbins, valid_row_leaf0=vrl,
             mono_type_pf=mono_full if has_mono else None,
+            interaction_groups=groups, rng_key=key,
+            feature_fraction_bynode=feature_fraction_bynode,
+            cat_sorted_mask=csm,
             parallel_mode="feature", local_bins=b_loc,
             local_meta=(loc_nbpf, loc_nanpf, loc_catpf, loc_fmask,
                         loc_mono if has_mono else None),
             feat_offset=offset, quant_scales=qs,
             mono_method=mono_method)
 
+    # replicated extras padded to the sharded feature width
+    qs, groups, key, csm = fp_extras
+    if groups is not None:
+        groups = jnp.pad(groups, ((0, 0), (0, pf)))
+    if csm is not None:
+        csm = jnp.pad(csm, (0, pf))
+    fp_extras = (qs, groups, key, csm)
+
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
     valid_in_specs = tuple([rep] * (2 * n_valid))
-    qs_specs = jax.tree.map(lambda _: rep, quant_scales)
+    extras_specs = jax.tree.map(lambda _: rep, fp_extras)
 
     fn = jax.shard_map(
         step, mesh=mesh,
         in_specs=(rep, fsh2, rep, rep, rep, rep, rep, rep,
-                  fsh, fsh, fsh, fsh, fsh, rep, valid_in_specs, qs_specs),
+                  fsh, fsh, fsh, fsh, fsh, rep, valid_in_specs,
+                  extras_specs),
         out_specs=(tree_specs, rep, tuple([rep] * n_valid)),
         check_vma=False)
     return fn(bins_p, bins_p, gh, row_leaf0, num_bins_p, nan_bin_p,
               is_cat_p, fmask_p, num_bins_p, nan_bin_p, is_cat_p, fmask_p,
-              mono_p, mono_p, valid_flat, quant_scales)
+              mono_p, mono_p, valid_flat, fp_extras)
 
 
 @functools.partial(
@@ -344,7 +357,7 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     def step(b, g, rl, nbpf, nanpf, catpf, fmask, vflat, extra):
         vbins = tuple(vflat[:n_valid])
         vrl = tuple(vflat[n_valid:])
-        mono, groups, key, bmeta, qs = extra
+        mono, groups, key, bmeta, qs, csm = extra
         return build_tree(
             b, g, rl, nbpf, nanpf, catpf, fmask,
             num_leaves=num_leaves, leaf_batch=leaf_batch,
@@ -357,7 +370,8 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             feature_fraction_bynode=feature_fraction_bynode,
             parallel_mode=parallel_mode, top_k=top_k,
             bundle_meta=bmeta, bundle_bins=bundle_bins,
-            quant_scales=qs, mono_method=mono_method)
+            quant_scales=qs, mono_method=mono_method,
+            cat_sorted_mask=csm)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
@@ -388,7 +402,8 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   feature_fraction_bynode: float = 1.0,
                   parallel_mode: str = "data", top_k: int = 20,
                   bundle_meta=None, bundle_bins: int = 0,
-                  quant_scales=None, mono_method: str = "basic"):
+                  quant_scales=None, mono_method: str = "basic",
+                  cat_sorted_mask=None):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
@@ -397,7 +412,7 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     """
     valid_flat = tuple(valid_bins) + tuple(valid_row_leaf0)
     extras = (mono_type_pf, interaction_groups, rng_key, bundle_meta,
-              quant_scales)
+              quant_scales, cat_sorted_mask)
     return _build_tree_dp_jit(
         mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
         feature_mask, valid_flat, extras, num_leaves=num_leaves,

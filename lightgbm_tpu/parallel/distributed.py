@@ -26,7 +26,8 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["init_distributed", "maybe_init_distributed",
-           "sync_bin_mappers", "global_mean_init_scores"]
+           "feature_blocks", "sync_bin_mappers",
+           "global_mean_init_scores"]
 
 _initialized = False
 
@@ -74,6 +75,13 @@ def maybe_init_distributed(config) -> bool:
     return True
 
 
+def feature_blocks(num_features: int, num_processes: int):
+    """The per-process feature ownership blocks. SINGLE SOURCE OF
+    TRUTH: Dataset._fit_mappers fits exactly these blocks and
+    sync_bin_mappers merges exactly these blocks — they must agree."""
+    return np.array_split(np.arange(num_features), num_processes)
+
+
 def sync_bin_mappers(bin_mappers: List) -> List:
     """Globally consistent bin mappers for pre-partitioned loading.
 
@@ -95,7 +103,7 @@ def sync_bin_mappers(bin_mappers: List) -> List:
         return bin_mappers
     from ..binning import BinMapper
     F = len(bin_mappers)
-    blocks = np.array_split(np.arange(F), P)
+    blocks = feature_blocks(F, P)
     mine = blocks[jax.process_index()]
 
     # serialize the owned block into flat arrays + offsets

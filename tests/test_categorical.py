@@ -1,0 +1,62 @@
+"""Sorted-subset categorical splits in TRAINING (find_best_cat_sorted
+wired through cat_sorted_mask — feature_histogram.cpp:172 picks the
+sorted path when num_bin > max_cat_to_onehot; reference tests:
+test_engine.py test_categorical_handling)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _cat_data(rng, n=3000, ncat=30):
+    cat = rng.randint(0, ncat, size=n)
+    means = rng.normal(size=ncat) * 2
+    X = np.column_stack([cat.astype(float), rng.normal(size=(n, 3))])
+    y = means[cat] + 0.5 * X[:, 1] + rng.normal(size=n) * 0.2
+    return X, y
+
+
+def test_sorted_subset_beats_onehot_on_high_cardinality(rng):
+    X, y = _cat_data(rng)
+    base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+            "min_data_in_leaf": 5, "min_data_per_group": 5}
+    srt = lgb.train(base, lgb.Dataset(X, label=y, categorical_feature=[0],
+                                      free_raw_data=False), 20)
+    oh = lgb.train(dict(base, max_cat_to_onehot=64),
+                   lgb.Dataset(X, label=y, categorical_feature=[0],
+                               free_raw_data=False), 20)
+    mse_s = np.mean((srt.predict(X) - y) ** 2)
+    mse_o = np.mean((oh.predict(X) - y) ** 2)
+    # grouping many categories per split must crush the one-bin-per-split
+    # one-hot path on 30 categories x 31 leaves
+    assert mse_s < mse_o * 0.5, (mse_s, mse_o)
+    # and the model must actually contain multi-category left sets
+    multi = any(any(bin(int(w)).count("1") > 1 for w in t.cat_threshold)
+                for t in srt._all_trees()
+                if len(getattr(t, "cat_threshold", [])))
+    assert multi
+
+
+def test_sorted_subset_model_roundtrip(rng, tmp_path):
+    X, y = _cat_data(rng, n=1500, ncat=20)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0],
+                                free_raw_data=False), 8)
+    f = str(tmp_path / "m.txt")
+    bst.save_model(f)
+    b2 = lgb.Booster(model_file=f)
+    np.testing.assert_allclose(b2.predict(X), bst.predict(X), atol=1e-10)
+
+
+def test_max_cat_threshold_caps_subset_size(rng):
+    X, y = _cat_data(rng, n=2000, ncat=25)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "min_data_per_group": 5, "max_cat_threshold": 2},
+                    lgb.Dataset(X, label=y, categorical_feature=[0],
+                                free_raw_data=False), 8)
+    for t in bst._all_trees():
+        for w in getattr(t, "cat_threshold", []):
+            assert bin(int(w)).count("1") <= 2

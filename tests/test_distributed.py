@@ -97,8 +97,9 @@ def test_global_mean_init_scores_mocked(monkeypatch):
 
 _WORKER = textwrap.dedent("""
     import os, sys, json
-    rank, port, outdir, repo = (int(sys.argv[1]), sys.argv[2],
-                                sys.argv[3], sys.argv[4])
+    rank, port, outdir, repo, mode = (int(sys.argv[1]), sys.argv[2],
+                                      sys.argv[3], sys.argv[4],
+                                      sys.argv[5])
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     sys.path.insert(0, repo)
     import jax
@@ -113,22 +114,29 @@ _WORKER = textwrap.dedent("""
     X = rng.normal(size=(n, 6))
     y = (X[:, 0] - 0.8 * X[:, 1] ** 2 + 0.5 * X[:, 2]
          + rng.normal(scale=0.3, size=n) > 0).astype(float)
-    # uneven pre-partitioned shards: worker 0 gets 2200 rows, worker 1
-    # the rest — mapper sync must still produce identical bins
-    cut = 2200
-    sl = slice(0, cut) if rank == 0 else slice(cut, n)
-    ds = lgb.Dataset(X[sl], label=y[sl],
-                     params={"pre_partition": True})
+    if mode == "pre_partition":
+        # uneven pre-partitioned shards: worker 0 gets 2200 rows,
+        # worker 1 the rest — mapper sync must still give identical bins
+        cut = 2200
+        sl = slice(0, cut) if rank == 0 else slice(cut, n)
+        ds = lgb.Dataset(X[sl], label=y[sl],
+                         params={"pre_partition": True})
+        params = {"pre_partition": True}
+    else:
+        # auto-partition: both workers load the FULL data; the loader
+        # keeps this rank's row block (dataset_loader.cpp:203 path)
+        sl = slice(0, n)
+        ds = lgb.Dataset(X, label=y)
+        params = {}
     bst = lgb.train({"objective": "binary", "num_leaves": 15,
-                     "tree_learner": "data", "pre_partition": True,
-                     "min_data_in_leaf": 5, "verbosity": -1},
+                     "tree_learner": "data",
+                     "min_data_in_leaf": 5, "verbosity": -1, **params},
                     ds, num_boost_round=8)
     txt = bst.model_to_string()
     from sklearn.metrics import roc_auc_score
     auc = roc_auc_score(y[sl], bst.predict(X[sl]))
     with open(os.path.join(outdir, f"out_{rank}.json"), "w") as f:
-        json.dump({"model_hash": hash(txt) & 0xffffffff,
-                   "model_len": len(txt), "auc": auc}, f)
+        json.dump({"model_len": len(txt), "auc": auc}, f)
     with open(os.path.join(outdir, f"model_{rank}.txt"), "w") as f:
         f.write(txt)
 """)
@@ -142,8 +150,7 @@ def _free_port() -> int:
     return port
 
 
-@pytest.mark.slow
-def test_two_process_data_parallel_training(tmp_path):
+def _run_two_workers(tmp_path, mode: str):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
     script.write_text(_WORKER)
@@ -151,10 +158,17 @@ def test_two_process_data_parallel_training(tmp_path):
     env = {k: v for k, v in os.environ.items()
            if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     procs = [subprocess.Popen(
-        [sys.executable, str(script), str(r), port, str(tmp_path), repo],
+        [sys.executable, str(script), str(r), port, str(tmp_path), repo,
+         mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for r in range(2)]
-    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    try:
+        outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-3000:]
     m0 = (tmp_path / "model_0.txt").read_text()
@@ -163,3 +177,13 @@ def test_two_process_data_parallel_training(tmp_path):
     r0 = json.loads((tmp_path / "out_0.json").read_text())
     r1 = json.loads((tmp_path / "out_1.json").read_text())
     assert r0["auc"] > 0.9 and r1["auc"] > 0.9, (r0, r1)
+
+
+@pytest.mark.slow
+def test_two_process_data_parallel_training(tmp_path):
+    _run_two_workers(tmp_path, "pre_partition")
+
+
+@pytest.mark.slow
+def test_two_process_auto_partition_training(tmp_path):
+    _run_two_workers(tmp_path, "auto")

@@ -193,3 +193,73 @@ def test_end_to_end_feature_booster(rng):
                      "tree_learner": "feature", "verbosity": -1}, ds, 8)
     from sklearn.metrics import roc_auc_score
     assert roc_auc_score(y, bst.predict(X)) > 0.9
+
+
+def test_feature_parallel_composes_with_constraints(rng):
+    """tree_learner=feature now composes with interaction constraints,
+    per-node sampling, and extra_trees (the reference composes them via
+    the templated learners, tree_learner.cpp:15-57): the sharded search
+    must match the serial learner exactly — the constraint state and
+    PRNG are replicated, so the sliced global mask is identical."""
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(1536, 8))
+    y = X[:, 0] * X[:, 1] + X[:, 2] ** 2 + 0.1 * rng.normal(size=1536)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "deterministic": True,
+            "interaction_constraints": [[0, 1, 4, 5], [2, 3, 6, 7]],
+            "extra_trees": True, "feature_fraction_bynode": 0.6}
+    serial = lgb.train(dict(base, tree_learner="serial"),
+                       lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    fp = lgb.train(dict(base, tree_learner="feature"),
+                   lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    np.testing.assert_allclose(serial.predict(X), fp.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_feature_parallel_sorted_cat(rng):
+    """Sorted-subset categorical splits under tree_learner=feature match
+    the serial learner (local window slice of cat_sorted_mask)."""
+    import lightgbm_tpu as lgb
+    n = 1536
+    ncat = 24
+    cat = rng.randint(0, ncat, size=n)
+    means = rng.normal(size=ncat) * 2
+    X = np.column_stack([cat.astype(float), rng.normal(size=(n, 5))])
+    y = means[cat] + 0.4 * X[:, 1] + 0.1 * rng.normal(size=n)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "min_data_per_group": 5}
+    serial = lgb.train(dict(base, tree_learner="serial"),
+                       lgb.Dataset(X, label=y, categorical_feature=[0],
+                                   free_raw_data=False), 6)
+    fp = lgb.train(dict(base, tree_learner="feature"),
+                   lgb.Dataset(X, label=y, categorical_feature=[0],
+                               free_raw_data=False), 6)
+    np.testing.assert_allclose(serial.predict(X), fp.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_efb_composes_with_voting(rng):
+    """EFB-bundled datasets now run under tree_learner=voting: local
+    unbundling commutes with the elected-column psum, so the result must
+    equal the EFB run under tree_learner=data (which is itself
+    oracle-tested against serial in test_efb.py)."""
+    import lightgbm_tpu as lgb
+    n, F = 2048, 12
+    X = np.zeros((n, F))
+    perm = rng.permutation(n)
+    for f in range(F):  # strictly exclusive features -> bundles form
+        rows = perm[f * (n // F):(f + 1) * (n // F)]
+        X[rows, f] = rng.normal(size=len(rows)) + 1.0
+    y = (X[:, 0] - X[:, 1] + 0.3 * X[:, 2] > 0.2).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "enable_bundle": True}
+    data = lgb.train(dict(base, tree_learner="data"),
+                     lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    voting = lgb.train(dict(base, tree_learner="voting",
+                            top_k=F),   # full top-k == data-parallel
+                       lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    np.testing.assert_allclose(data.predict(X), voting.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    # the bundles must actually have formed, or this test is vacuous
+    ds = lgb.Dataset(X, label=y).construct()
+    assert ds.bundle_plan is not None
