@@ -217,6 +217,9 @@ PARAMS: Dict[str, ParamSpec] = {
         _p("predict_contrib", False, bool, aliases=("is_predict_contrib",
                                                     "contrib")),
         _p("predict_disable_shape_check", False, bool),
+        _p("pred_early_stop", False, bool),
+        _p("pred_early_stop_freq", 10, int, check=lambda v: v > 0),
+        _p("pred_early_stop_margin", 10.0, float, check=lambda v: v >= 0),
         # -- objective --
         _p("num_class", 1, int, aliases=("num_classes",),
            check=lambda v: v > 0),

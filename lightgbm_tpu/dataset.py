@@ -65,6 +65,54 @@ def _is_arrow(data) -> bool:
     return hasattr(data, "column_names") and hasattr(data, "num_rows")
 
 
+def _is_pandas_df(data) -> bool:
+    return (hasattr(data, "dtypes") and hasattr(data, "columns")
+            and hasattr(data, "values") and not _is_arrow(data))
+
+
+def _data_from_pandas(df, align_categories=None):
+    """DataFrame -> (f64 matrix, category column indices, category
+    lists). The reference's ``_data_from_pandas``
+    (python-package/lightgbm/basic.py): ``category``-dtype columns map
+    to their codes (missing -> NaN), every other column must be
+    int/float/bool, and at valid/predict time the codes are ALIGNED to
+    the training category lists (``align_categories``)."""
+    import pandas as pd
+
+    def _is_cat(dt):
+        return isinstance(dt, pd.CategoricalDtype) or str(dt) == "category"
+
+    cat_idx = [i for i, dt in enumerate(df.dtypes) if _is_cat(dt)]
+    bad = [str(c) for c, dt in zip(df.columns, df.dtypes)
+           if not _is_cat(dt) and getattr(dt, "kind", "O") not in "iufb"]
+    if bad:
+        raise ValueError(
+            "DataFrame.dtypes for data must be int, float or bool.\n"
+            "Did not expect the data types in the following fields: "
+            + ", ".join(bad))
+    if align_categories is not None and len(align_categories) != len(
+            cat_idx):
+        raise ValueError(
+            "train and valid dataset categorical_feature do not match.")
+    out = np.empty(df.shape, np.float64)
+    cats_out = []
+    cat_set = set(cat_idx)
+    j = 0
+    for i, col in enumerate(df.columns):
+        s = df[col]
+        if i in cat_set:
+            if align_categories is not None:
+                s = s.cat.set_categories(align_categories[j])
+            cats_out.append(list(s.cat.categories))
+            codes = np.asarray(s.cat.codes, np.float64)
+            codes[codes < 0] = np.nan
+            out[:, i] = codes
+            j += 1
+        else:
+            out[:, i] = np.asarray(s, np.float64)
+    return out, cat_idx, cats_out
+
+
 def _to_2d_float(data) -> np.ndarray:
     if _is_arrow(data):
         # pyarrow Table (arrow.h ArrowChunkedArray ingestion analog):
@@ -115,6 +163,7 @@ class Dataset:
         self.free_raw_data = free_raw_data
 
         self.bin_mappers: List[BinMapper] = []
+        self.pandas_categorical = None   # per-cat-column category lists
         self.raw_values: Optional[np.ndarray] = None  # kept for linear_tree
         self.bundle_plan = None                     # EFB layout (efb.py)
         self.bins: Optional[np.ndarray] = None      # [num_data, F|G] int
@@ -172,12 +221,19 @@ class Dataset:
             if self.position is None and loaded.position is not None:
                 self.position = loaded.position
         sparse = _is_sparse(self._raw_data)
+        pd_cat_idx = None
         if sparse:
             # scipy CSR/CSC input: binning samples densify per-row, full
             # extraction streams per-column — the dense [R, F] matrix
             # never materializes (SparseBin/CSR ingestion analog)
             data = self._raw_data.tocsr()
             data_csc = None
+        elif _is_pandas_df(self._raw_data):
+            ref_cats = (self.reference.pandas_categorical
+                        if self.reference is not None else None)
+            data, pd_cat_idx, cats = _data_from_pandas(
+                self._raw_data, ref_cats)
+            self.pandas_categorical = cats or None
         else:
             data = _to_2d_float(self._raw_data)
         if (self.reference is not None
@@ -215,6 +271,10 @@ class Dataset:
         self.feature_name = names
 
         cat_idx = self._resolve_categoricals(names)
+        if pd_cat_idx and self.categorical_feature in ("auto", None):
+            # categorical_feature='auto': pandas category dtypes become
+            # categorical features (basic.py _data_from_pandas)
+            cat_idx = cat_idx | set(pd_cat_idx)
 
         if self.reference is not None:
             # validation set: reuse the training bin mappers
@@ -623,6 +683,7 @@ class Dataset:
                             else self.raw_values[idx])
         child.position = (None if self.position is None
                           else self.position[idx])
+        child.pandas_categorical = self.pandas_categorical
         child._constructed = True
         return child
 
@@ -694,6 +755,10 @@ class Dataset:
             v = getattr(self, field)
             if v is not None:
                 payload[field] = v
+        if self.pandas_categorical is not None:
+            import json as _json
+            payload["pandas_categorical"] = np.asarray(_json.dumps(
+                self.pandas_categorical, default=str))
         scal, ubs, cats = [], [], []
         ub_off, cat_off = [0], [0]
         for m in self.bin_mappers:
@@ -741,6 +806,10 @@ class Dataset:
                           "position"):
                 if field in z and getattr(self, field) is None:
                     setattr(self, field, z[field])
+            if "pandas_categorical" in z:
+                import json as _json
+                self.pandas_categorical = _json.loads(
+                    str(z["pandas_categorical"]))
             scal = z["mapper_scalars"]
             ub, ub_off = z["mapper_ub"], z["mapper_ub_off"]
             cats, cat_off = z["mapper_cats"], z["mapper_cat_off"]

@@ -57,6 +57,7 @@ class Booster:
         self._metrics: List[Metric] = []
         self._train_metrics_data = None
         self._average_output = False  # RF mode (rf.hpp average_output_)
+        self._pandas_categorical = None  # train-time category lists
 
         if model_file is not None:
             with open(model_file) as f:
@@ -83,6 +84,7 @@ class Booster:
         self._metrics = create_metrics(self.config)
         self._feature_names = list(train_set.feature_name)
         self._max_feature_idx = train_set.num_total_features - 1
+        self._pandas_categorical = train_set.pandas_categorical
 
     # -- training ------------------------------------------------------
     def _all_trees(self) -> List[Tree]:
@@ -319,7 +321,8 @@ class Booster:
             if self._average_output and use:
                 out /= len(use) // K
             return out
-        raw = self._predict_raw_scores(X, use, lo, K)
+        es = self._early_stop_config(kwargs)
+        raw = self._predict_raw_scores(X, use, lo, K, early_stop=es)
         if self._average_output and use:
             raw /= len(use) // K
         if K == 1:
@@ -328,8 +331,64 @@ class Booster:
             return raw
         return self._converted(raw)
 
+    def _predict_host_early_stop(self, X, use, lo, K, freq, margin):
+        """Host path of GBDT::PredictRaw's early-stop loop
+        (gbdt_prediction.cpp:13-31): rows that clear the margin every
+        ``freq`` iterations drop out of the remaining tree walks."""
+        n = X.shape[0]
+        raw = np.zeros((n, K))
+        active = np.arange(n)
+        n_iters = len(use) // K
+        counter = 0
+        for it in range(n_iters):
+            if len(active) == 0:
+                break
+            Xa = X[active]
+            for k in range(K):
+                t = use[it * K + k]
+                raw[active, (lo + it * K + k) % K] += t.predict(Xa)
+            counter += 1
+            if counter == freq:
+                counter = 0
+                if K == 1:
+                    m = 2.0 * np.abs(raw[active, 0])
+                else:
+                    srt = np.sort(raw[active], axis=1)
+                    m = srt[:, -1] - srt[:, -2]
+                active = active[m <= margin]
+        # trailing partial iterations (len(use) % K trees) never happen:
+        # callers slice whole iterations
+        return raw
+
+    # objectives whose predictions tolerate early stopping — the ones
+    # overriding NeedAccuratePrediction() to false (binary_objective.hpp
+    # :188, multiclass_objective.hpp:153,259, rank_objective.hpp:108);
+    # Predictor then picks binary/multiclass by class count
+    # (predictor.hpp:46-58)
+    _EARLY_STOP_OBJECTIVES = ("binary", "multiclass", "multiclassova",
+                              "lambdarank", "rank_xendcg")
+
+    def _early_stop_config(self, kwargs):
+        """(freq, margin) when pred_early_stop applies, else None."""
+        def get(name, default):
+            if name in kwargs:
+                return kwargs[name]
+            return self.params.get(name, default)
+        if not get("pred_early_stop", False):
+            return None
+        obj = str(self.params.get("objective", "")).split(" ")[0]
+        if obj not in self._EARLY_STOP_OBJECTIVES:
+            return None
+        freq = int(get("pred_early_stop_freq", 10))
+        margin = float(get("pred_early_stop_margin", 10.0))
+        if freq <= 0 or margin < 0:
+            raise ValueError(
+                "pred_early_stop_freq must be > 0 and "
+                "pred_early_stop_margin >= 0")
+        return freq, margin
+
     def _predict_raw_scores(self, X: np.ndarray, use, lo: int,
-                            K: int) -> np.ndarray:
+                            K: int, early_stop=None) -> np.ndarray:
         """[n, K] raw scores. Large batches run the whole ensemble
         on-device (ops/predict_ensemble — predictor.hpp's OpenMP batch
         path, recast as a [rows, trees] lock-step walk); small ones and
@@ -344,9 +403,42 @@ class Booster:
                       and not any(t.is_linear for t in use)
                       and n * len(use) >= (1 << 16))
         if not use_device:
+            if early_stop is not None and len(use) >= K:
+                return self._predict_host_early_stop(X, use, lo, K,
+                                                     *early_stop)
             raw = np.zeros((n, K))
             for i, t in enumerate(use):
                 raw[:, (lo + i) % K] += t.predict(X)
+            return raw
+        if early_stop is not None and len(use) >= K and lo % K == 0:
+            # NOTE: this path accumulates per-class sums in f32 ON
+            # DEVICE (the margin test needs the running total inside the
+            # loop; TPUs have no f64) — unlike the plain device path,
+            # whose per-class accumulation runs in f64 on host. Turning
+            # pred_early_stop on can therefore shift predictions by f32
+            # accumulation rounding even with an unreachable margin.
+            from .ops.predict_ensemble import (
+                pack_ensemble, predict_raw_device_early_stop)
+            import jax.numpy as jnp
+            freq, margin = early_stop
+            key = (self._model_version, lo, lo + len(use))
+            if getattr(self, "_packed_key", None) != key:
+                self._packed = pack_ensemble(use)
+                self._packed_key = key
+            raw = np.zeros((n, K))
+            chunk = max(1024, (1 << 22) // max(len(use), 1))
+            chunk = min(chunk, -(-n // 1024) * 1024)
+            for s0 in range(0, n, chunk):
+                Xc = X[s0:s0 + chunk]
+                real = Xc.shape[0]
+                if real < chunk:  # ONE compiled shape across tails
+                    Xc = np.concatenate(
+                        [Xc, np.zeros((chunk - real, X.shape[1]))])
+                out = np.asarray(predict_raw_device_early_stop(
+                    self._packed, jnp.asarray(Xc, jnp.float32),
+                    jnp.asarray(margin, jnp.float32), K=K, freq=freq),
+                    np.float64)
+                raw[s0:s0 + real] = out[:real]
             return raw
         import jax
         import jax.numpy as jnp
@@ -389,8 +481,13 @@ class Booster:
                 data, num_features_hint=len(self._feature_names)).X
         if hasattr(data, "tocsr"):  # scipy sparse: densify for traversal
             data = np.asarray(data.todense())
-        # arrow Tables / DataFrames / arrays share the Dataset converter
-        from .dataset import _to_2d_float
+        from .dataset import _to_2d_float, _is_pandas_df, _data_from_pandas
+        if _is_pandas_df(data):
+            # category columns align to the TRAINING category lists so
+            # codes mean the same thing (basic.py _data_from_pandas
+            # predict path)
+            arr, _, _ = _data_from_pandas(data, self._pandas_categorical)
+            return arr
         return _to_2d_float(data)
 
     # -- model IO (gbdt_model_text.cpp analog) -------------------------
@@ -431,7 +528,19 @@ class Booster:
         tail += ["", "parameters:"]
         for key, val in sorted(self.params.items()):
             tail.append(f"[{key}: {val}]")
-        tail += ["end of parameters", "", "pandas_categorical:null", ""]
+        import json as _json
+
+        def _py(o):
+            if isinstance(o, (np.integer,)):
+                return int(o)
+            if isinstance(o, (np.floating,)):
+                return float(o)
+            if isinstance(o, (np.bool_,)):
+                return bool(o)
+            return str(o)
+        pc = (_json.dumps(self._pandas_categorical, default=_py)
+              if self._pandas_categorical else "null")
+        tail += ["end of parameters", "", "pandas_categorical:" + pc, ""]
         return "\n".join(header) + "\n" + body + "\n".join(tail)
 
     def dump_model(self, num_iteration: Optional[int] = None,
@@ -484,7 +593,7 @@ class Booster:
             "feature_importances": {
                 self._feature_names[i]: float(imp[i])
                 for i in np.argsort(-imp, kind="stable") if imp[i] > 0},
-            "pandas_categorical": None,
+            "pandas_categorical": self._pandas_categorical,
         }
 
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
@@ -536,6 +645,15 @@ class Booster:
                 header["average_output"] = "1"
             i += 1
         self._average_output = "average_output" in header
+        for ln in reversed(lines[-8:]):
+            if ln.startswith("pandas_categorical:"):
+                import json as _json
+                val = ln.split(":", 1)[1]
+                try:
+                    self._pandas_categorical = _json.loads(val)
+                except Exception:
+                    self._pandas_categorical = None
+                break
         self._num_class = int(header.get("num_class", "1"))
         self._max_feature_idx = int(header.get("max_feature_idx", "0"))
         obj = header.get("objective", "regression").split()

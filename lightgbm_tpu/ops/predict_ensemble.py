@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PackedEnsemble", "pack_ensemble", "predict_raw_device"]
+__all__ = ["PackedEnsemble", "pack_ensemble", "predict_raw_device",
+           "predict_raw_device_early_stop"]
 
 
 class PackedEnsemble(NamedTuple):
@@ -69,8 +70,7 @@ def pack_ensemble(trees: List) -> PackedEnsemble:
                                (sf, thr, dt, lc, rc, lv, cb, cw, nl)))
 
 
-@jax.jit
-def predict_raw_device(ens: PackedEnsemble, X: jax.Array) -> jax.Array:
+def _walk(ens: PackedEnsemble, X: jax.Array) -> jax.Array:
     """[n, T] per-tree outputs for raw features X [n, F] (f32; NaN ok).
 
     Decision semantics mirror tree.h NumericalDecision /
@@ -135,3 +135,69 @@ def predict_raw_device(ens: PackedEnsemble, X: jax.Array) -> jax.Array:
     out = jax.vmap(lambda col, at: jnp.take(at, col),
                    in_axes=(1, 0), out_axes=1)(leaf, ens.leaf_value)
     return out
+
+
+predict_raw_device = jax.jit(_walk)
+
+
+@functools.partial(jax.jit, static_argnames=("K", "freq"))
+def predict_raw_device_early_stop(ens: PackedEnsemble, X: jax.Array,
+                                  margin: jax.Array, *, K: int,
+                                  freq: int) -> jax.Array:
+    """[n, K] accumulated raw scores with prediction early stopping
+    (PredictionEarlyStopInstance, prediction_early_stop.cpp:91, driven
+    by GBDT::PredictRaw's round counter, gbdt_prediction.cpp:13-31).
+
+    TPU shape: per-ROW early exit cannot skip SIMD lanes, so the stop is
+    chunk-granular — a while_loop over blocks of ``freq`` iterations
+    (``freq * K`` trees) that exits when EVERY row has cleared the
+    margin. Done rows freeze (their remaining trees are skipped exactly
+    like the reference's per-row break); the wall-clock win appears once
+    all rows in the batch are confident. K == 1 uses the binary margin
+    2*|raw|, K > 1 the multiclass top1-top2 margin.
+    """
+    n = X.shape[0]
+    T = ens.split_feature.shape[0]
+    chunk = K * freq
+    n_chunks = -(-T // chunk)
+    pad = n_chunks * chunk - T
+    if pad > 0:
+        # stump padding: num_leaves=1 routes to leaf 0 with value 0
+        def padt(a, fill=0):
+            return jnp.pad(a, ((0, pad),) + ((0, 0),) * (a.ndim - 1),
+                           constant_values=fill)
+        ens = PackedEnsemble(
+            padt(ens.split_feature), padt(ens.threshold),
+            padt(ens.decision_type), padt(ens.left_child, -1),
+            padt(ens.right_child, -1), padt(ens.leaf_value),
+            padt(ens.cat_bound), padt(ens.cat_words),
+            padt(ens.num_leaves, 1))
+    # tree i of every chunk belongs to class i % K (trees are stored
+    # iteration-major, and chunks hold whole iterations)
+    cls_oh = (jnp.arange(chunk, dtype=jnp.int32)[:, None] % K
+              == jnp.arange(K, dtype=jnp.int32)[None, :]).astype(
+        jnp.float32)
+
+    def cond(st):
+        c, _, done = st
+        return (c < n_chunks) & ~jnp.all(done)
+
+    def body(st):
+        c, raw, done = st
+        sub = PackedEnsemble(*[
+            jax.lax.dynamic_slice_in_dim(a, c * chunk, chunk, axis=0)
+            for a in ens])
+        add = _walk(sub, X) @ cls_oh                      # [n, K]
+        raw = raw + jnp.where(done[:, None], 0.0, add)
+        if K == 1:
+            m = 2.0 * jnp.abs(raw[:, 0])
+        else:
+            top2, _ = jax.lax.top_k(raw, 2)
+            m = top2[:, 0] - top2[:, 1]
+        return c + 1, raw, done | (m > margin)
+
+    state = (jnp.asarray(0, jnp.int32),
+             jnp.zeros((n, K), jnp.float32),
+             jnp.zeros((n,), bool))
+    _, raw, _ = jax.lax.while_loop(cond, body, state)
+    return raw

@@ -1,0 +1,159 @@
+"""Prediction early stopping (prediction_early_stop.cpp:91 +
+gbdt_prediction.cpp:13-31) and pandas-native ingestion
+(basic.py _data_from_pandas)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_model(rng, n=3000, rounds=40):
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), rounds)
+    return X, y, bst
+
+
+def test_pred_early_stop_binary_device(rng):
+    X, y, bst = _binary_model(rng)
+    full = bst.predict(X, raw_score=True)
+    es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=1.5)
+    stopped = np.abs(full - es) > 1e-3
+    assert stopped.any(), "margin 1.5 must stop confident rows early"
+    # every frozen row had cleared the margin when it stopped
+    assert (2 * np.abs(es[stopped]) > 1.5 - 1e-4).all()
+    # a huge margin must never stop -> identical to the full walk
+    np.testing.assert_allclose(
+        bst.predict(X, raw_score=True, pred_early_stop=True,
+                    pred_early_stop_margin=1e9),
+        full, rtol=2e-5, atol=2e-5)
+
+
+def test_pred_early_stop_binary_host_path(rng):
+    X, y, bst = _binary_model(rng)
+    full = bst.predict(X, raw_score=True)
+    # tiny batch routes through the host tree walk
+    es = bst.predict(X[:100], raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=1.5)
+    stopped = np.abs(full[:100] - es) > 1e-3
+    assert stopped.any()
+    assert (2 * np.abs(es[stopped]) > 1.5 - 1e-9).all()
+
+
+def test_pred_early_stop_multiclass(rng):
+    X = rng.normal(size=(2000, 5))
+    y = ((X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)).astype(
+        float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 30)
+    full = bst.predict(X, raw_score=True)
+    es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=1.0)
+    stopped = np.abs(full - es).max(axis=1) > 1e-3
+    assert stopped.any()
+    srt = np.sort(es[stopped], axis=1)
+    assert (srt[:, -1] - srt[:, -2] > 1.0 - 1e-4).all()
+
+
+def test_pred_early_stop_ignored_for_regression(rng):
+    X = rng.normal(size=(500, 4))
+    y = X[:, 0] + 0.1 * rng.normal(size=500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 20)
+    # NeedAccuratePrediction objectives never early-stop (predictor.hpp)
+    np.testing.assert_allclose(
+        bst.predict(X, pred_early_stop=True, pred_early_stop_margin=0.0),
+        bst.predict(X))
+
+
+# ------------------------- pandas ingestion -------------------------
+
+pd = pytest.importorskip("pandas")
+
+
+def _pandas_frame(rng, n=2500):
+    colors = np.array(["red", "green", "blue", "teal", "pink", "gold"])
+    c = rng.randint(0, 6, size=n)
+    means = np.asarray([3.0, -2.0, 0.5, 1.5, -1.0, 2.2])
+    df = pd.DataFrame({
+        "color": pd.Categorical(colors[c], categories=colors),
+        "x1": rng.normal(size=n),
+        "flag": rng.rand(n) > 0.5,
+        "count": rng.randint(0, 100, size=n),
+    })
+    y = means[c] + 0.3 * df["x1"].to_numpy() + rng.normal(size=n) * 0.1
+    return df, y, colors, c
+
+
+def test_pandas_categorical_train_predict(rng):
+    df, y, colors, c = _pandas_frame(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), 15)
+    p1 = bst.predict(df)
+    r2 = 1 - np.mean((p1 - y) ** 2) / np.var(y)
+    assert r2 > 0.9, r2
+    # the category column must actually train as categorical
+    assert any(t.num_cat > 0 for t in bst._all_trees())
+
+
+def test_pandas_category_alignment_and_roundtrip(rng):
+    df, y, colors, c = _pandas_frame(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), 10)
+    p1 = bst.predict(df)
+    # same values, REVERSED category level order: codes differ, but the
+    # predict path aligns to the training lists
+    df2 = df.copy()
+    df2["color"] = pd.Categorical(colors[c], categories=colors[::-1])
+    np.testing.assert_allclose(bst.predict(df2), p1)
+    # the category lists survive the v4 text format
+    txt = bst.model_to_string()
+    assert "pandas_categorical:[[" in txt
+    b2 = lgb.Booster(model_str=txt)
+    np.testing.assert_allclose(b2.predict(df2), p1, atol=1e-10)
+
+
+def test_pandas_unseen_category_is_missing(rng):
+    df, y, colors, c = _pandas_frame(rng)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "min_data_per_group": 5},
+                    lgb.Dataset(df, label=y), 10)
+    df3 = df.iloc[:50].copy()
+    df3["color"] = pd.Categorical(["ultraviolet"] * 50)
+    out = bst.predict(df3)          # unseen category -> NaN -> default
+    assert np.isfinite(out).all()
+
+
+def test_pandas_bad_dtype_rejected(rng):
+    df, y, _, _ = _pandas_frame(rng, n=200)
+    df["oops"] = ["text"] * len(df)
+    with pytest.raises(ValueError, match="int, float or bool"):
+        lgb.Dataset(df, label=y).construct()
+
+
+def test_pandas_valid_set_uses_train_categories(rng):
+    df, y, colors, c = _pandas_frame(rng)
+    tr = lgb.Dataset(df.iloc[:2000], label=y[:2000])
+    # valid frame declares only the categories it happens to contain —
+    # alignment must remap them onto the train lists
+    dv = df.iloc[2000:].copy()
+    dv["color"] = pd.Categorical(dv["color"].astype(str))
+    va = lgb.Dataset(dv, label=y[2000:], reference=tr)
+    evals = {}
+    lgb.train({"objective": "regression", "num_leaves": 15,
+               "verbosity": -1, "min_data_in_leaf": 5,
+               "min_data_per_group": 5}, tr, 10, valid_sets=[va],
+              callbacks=[lgb.record_evaluation(evals)])
+    final = evals["valid_0"]["l2"][-1]
+    assert final < np.var(y[2000:]) * 0.3, final
