@@ -229,11 +229,17 @@ class Dataset:
             data = self._raw_data.tocsr()
             data_csc = None
         elif _is_pandas_df(self._raw_data):
-            ref_cats = (self.reference.pandas_categorical
-                        if self.reference is not None else None)
+            # a valid set aligns to its train set's category lists; a
+            # train set trained WITHOUT pandas gets [] so a categorical
+            # frame against it raises the reference's mismatch error
+            ref_cats = None
+            if self.reference is not None:
+                ref_cats = self.reference.pandas_categorical
+                if ref_cats is None:
+                    ref_cats = []
             data, pd_cat_idx, cats = _data_from_pandas(
                 self._raw_data, ref_cats)
-            self.pandas_categorical = cats or None
+            self.pandas_categorical = cats
         else:
             data = _to_2d_float(self._raw_data)
         if (self.reference is not None
@@ -757,8 +763,17 @@ class Dataset:
                 payload[field] = v
         if self.pandas_categorical is not None:
             import json as _json
+
+            def _py(o):
+                if isinstance(o, np.integer):
+                    return int(o)
+                if isinstance(o, np.floating):
+                    return float(o)
+                if isinstance(o, np.bool_):
+                    return bool(o)
+                return str(o)
             payload["pandas_categorical"] = np.asarray(_json.dumps(
-                self.pandas_categorical, default=str))
+                self.pandas_categorical, default=_py))
         scal, ubs, cats = [], [], []
         ub_off, cat_off = [0], [0]
         for m in self.bin_mappers:

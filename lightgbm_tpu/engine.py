@@ -9,6 +9,7 @@ no ctypes).
 
 from __future__ import annotations
 
+import collections
 import copy
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, \
     Union
@@ -376,8 +377,7 @@ class Booster:
             return self.params.get(name, default)
         if not get("pred_early_stop", False):
             return None
-        obj = str(self.params.get("objective", "")).split(" ")[0]
-        if obj not in self._EARLY_STOP_OBJECTIVES:
+        if self._objective_name not in self._EARLY_STOP_OBJECTIVES:
             return None
         freq = int(get("pred_early_stop_freq", 10))
         margin = float(get("pred_early_stop_margin", 10.0))
@@ -410,63 +410,57 @@ class Booster:
             for i, t in enumerate(use):
                 raw[:, (lo + i) % K] += t.predict(X)
             return raw
-        if early_stop is not None and len(use) >= K and lo % K == 0:
+        import jax.numpy as jnp
+        from .ops.predict_ensemble import (pack_ensemble,
+                                           predict_raw_device,
+                                           predict_raw_device_early_stop)
+        key = (self._model_version, lo, lo + len(use))
+        if getattr(self, "_packed_key", None) != key:
+            self._packed = pack_ensemble(use)
+            self._packed_key = key
+
+        def run_chunked(kernel, out_cols):
+            """Fixed-shape row chunks (pad ragged tails so repeat batch
+            sizes hit one compiled program); kernel: f32 [chunk, F] ->
+            [chunk, out_cols]."""
+            out = np.zeros((n, out_cols))
+            chunk = max(1024, (1 << 22) // max(len(use), 1))
+            chunk = min(chunk, -(-n // 1024) * 1024)
+            for s0 in range(0, n, chunk):
+                Xc = X[s0:s0 + chunk]
+                real = Xc.shape[0]
+                if real < chunk:
+                    Xc = np.concatenate(
+                        [Xc, np.zeros((chunk - real, X.shape[1]))])
+                res = np.asarray(kernel(jnp.asarray(Xc, jnp.float32)),
+                                 np.float64)
+                out[s0:s0 + real] = res[:real]
+            return out
+
+        if early_stop is not None and len(use) >= K:
             # NOTE: this path accumulates per-class sums in f32 ON
             # DEVICE (the margin test needs the running total inside the
             # loop; TPUs have no f64) — unlike the plain device path,
             # whose per-class accumulation runs in f64 on host. Turning
             # pred_early_stop on can therefore shift predictions by f32
             # accumulation rounding even with an unreachable margin.
-            from .ops.predict_ensemble import (
-                pack_ensemble, predict_raw_device_early_stop)
-            import jax.numpy as jnp
             freq, margin = early_stop
-            key = (self._model_version, lo, lo + len(use))
-            if getattr(self, "_packed_key", None) != key:
-                self._packed = pack_ensemble(use)
-                self._packed_key = key
-            raw = np.zeros((n, K))
-            chunk = max(1024, (1 << 22) // max(len(use), 1))
-            chunk = min(chunk, -(-n // 1024) * 1024)
-            for s0 in range(0, n, chunk):
-                Xc = X[s0:s0 + chunk]
-                real = Xc.shape[0]
-                if real < chunk:  # ONE compiled shape across tails
-                    Xc = np.concatenate(
-                        [Xc, np.zeros((chunk - real, X.shape[1]))])
-                out = np.asarray(predict_raw_device_early_stop(
-                    self._packed, jnp.asarray(Xc, jnp.float32),
-                    jnp.asarray(margin, jnp.float32), K=K, freq=freq),
-                    np.float64)
-                raw[s0:s0 + real] = out[:real]
-            return raw
-        import jax
-        import jax.numpy as jnp
-        from .ops.predict_ensemble import (pack_ensemble,
-                                           predict_raw_device)
-        key = (self._model_version, lo, lo + len(use))
-        if getattr(self, "_packed_key", None) != key:
-            self._packed = pack_ensemble(use)
-            self._packed_key = key
+            mj = jnp.asarray(margin, jnp.float32)
+            return run_chunked(
+                lambda Xc: predict_raw_device_early_stop(
+                    self._packed, Xc, mj, K=K, freq=freq), K)
+
         cls = np.asarray([(lo + i) % K for i in range(len(use))])
-        raw = np.zeros((n, K))
-        chunk = max(1024, (1 << 22) // max(len(use), 1))
-        # don't pad small batches to a huge canonical chunk — cap near n
-        # (multiple of 1024 keeps repeat batch sizes on one shape)
-        chunk = min(chunk, -(-n // 1024) * 1024)
-        for s0 in range(0, n, chunk):
-            Xc = X[s0:s0 + chunk]
-            pad = chunk - Xc.shape[0]
-            if pad > 0:  # keep ONE compiled shape across ragged tails
-                Xc = np.concatenate([Xc, np.zeros((pad, X.shape[1]))])
-            outs = np.asarray(predict_raw_device(
-                self._packed, jnp.asarray(Xc, jnp.float32)), np.float64)
-            if pad > 0:
-                outs = outs[:chunk - pad]
-            for k in range(K):
-                raw[s0:s0 + outs.shape[0], k] = \
-                    outs[:, cls == k].sum(axis=1)
-        return raw
+
+        def plain_kernel(Xc):
+            # per-chunk [chunk, T] -> [chunk, K] immediately (f64 on
+            # host, and the per-tree matrix never exceeds one chunk)
+            outs = np.asarray(predict_raw_device(self._packed, Xc),
+                              np.float64)
+            return np.stack([outs[:, cls == k].sum(axis=1)
+                             for k in range(K)], axis=1)
+
+        return run_chunked(plain_kernel, K)
 
     def _as_matrix(self, data) -> np.ndarray:
         if isinstance(data, Dataset):
@@ -485,8 +479,10 @@ class Booster:
         if _is_pandas_df(data):
             # category columns align to the TRAINING category lists so
             # codes mean the same thing (basic.py _data_from_pandas
-            # predict path)
-            arr, _, _ = _data_from_pandas(data, self._pandas_categorical)
+            # predict path); a model never trained from pandas aligns
+            # against [] -> categorical frames raise the mismatch error
+            arr, _, _ = _data_from_pandas(
+                data, self._pandas_categorical or [])
             return arr
         return _to_2d_float(data)
 
@@ -897,7 +893,15 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     raw = train_set._raw_data
     if raw is None:
         raise ValueError("cv requires train_set with free_raw_data=False")
-    X = np.asarray(raw, dtype=np.float64)
+    from .dataset import _is_pandas_df as _is_pd
+    if _is_pd(raw):
+        def X_rows(ix):   # keep the frame: category dtypes must survive
+            return raw.iloc[ix]
+    else:
+        _X = np.asarray(raw, dtype=np.float64)
+
+        def X_rows(ix):
+            return _X[ix]
 
     def _group_sizes(row_idx):
         if group is None:
@@ -907,34 +911,75 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         _, sizes = np.unique(qid, return_counts=True)
         return sizes
 
-    results: Dict[str, List[float]] = {}
+    # per-fold boosters train in LOCKSTEP, one round each per cv round,
+    # so callbacks (and early stopping in particular) see the
+    # cross-fold AGGREGATED metrics — the reference's design
+    # (engine.py:625 cv loop + _agg_cv_result)
     cvb = CVBooster()
-    fold_histories = []
     for tr_idx, te_idx in folds:
-        dtrain = Dataset(X[tr_idx], label=label[tr_idx],
+        dtrain = Dataset(X_rows(tr_idx), label=label[tr_idx],
                          weight=None if weight is None else weight[tr_idx],
                          group=_group_sizes(tr_idx),
                          init_score=None if init_score is None
                          else init_score[tr_idx],
                          params=dict(train_set.params))
-        dvalid = Dataset(X[te_idx], label=label[te_idx],
+        dvalid = Dataset(X_rows(te_idx), label=label[te_idx],
                          weight=None if weight is None else weight[te_idx],
                          group=_group_sizes(te_idx),
                          init_score=None if init_score is None
                          else init_score[te_idx], reference=dtrain)
-        from .callback import record_evaluation
-        hist: Dict = {}
-        cbs = list(callbacks or []) + [record_evaluation(hist)]
-        bst = train(params, dtrain, num_boost_round, valid_sets=[dvalid],
-                    valid_names=["valid"], callbacks=cbs)
+        bst = Booster(dict(params), dtrain)
+        bst.add_valid(dvalid, "valid")
         cvb.append(bst)
-        fold_histories.append(hist.get("valid", {}))
-    # aggregate
-    for metric in (fold_histories[0] or {}):
-        rounds = min(len(h[metric]) for h in fold_histories)
-        vals = np.asarray([h[metric][:rounds] for h in fold_histories])
-        results[f"valid {metric}-mean"] = vals.mean(axis=0).tolist()
-        results[f"valid {metric}-stdv"] = vals.std(axis=0).tolist()
+
+    cbs = list(callbacks or [])
+    cfg_cv = Config(params)
+    if cfg_cv.early_stopping_round and cfg_cv.early_stopping_round > 0 \
+            and not any(getattr(c, "order", 0) == 30 for c in cbs):
+        from .callback import early_stopping as _es
+        cbs.append(_es(cfg_cv.early_stopping_round,
+                       first_metric_only=bool(
+                           cfg_cv.first_metric_only)))
+    cbs = sorted(cbs, key=lambda c: getattr(c, "order", 0))
+    cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
+    cbs_after = [c for c in cbs if not getattr(c, "before_iteration",
+                                               False)]
+    results: Dict[str, List[float]] = {}
+    name_map = {"training": "train"}  # reference cv key naming
+    for it in range(num_boost_round):
+        for cb in cbs_before:
+            cb(CallbackEnv(cvb, params, it, 0, num_boost_round, None))
+        finished = True
+        for bst in cvb.boosters:
+            finished = bst.update() and finished
+        # aggregate fold metrics: mean/stdv per (dataset, metric)
+        agg = collections.OrderedDict()
+        for bst in cvb.boosters:
+            res = list(bst.eval_valid())
+            if eval_train_metric:
+                res = list(bst.eval_train()) + res
+            for nm, metric, value, bigger in res:
+                nm = name_map.get(nm, nm)
+                agg.setdefault((nm, metric), ([], bigger))[0].append(value)
+        eval_list = []
+        for (nm, metric), (vals, bigger) in agg.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results.setdefault(f"{nm} {metric}-mean", []).append(mean)
+            results.setdefault(f"{nm} {metric}-stdv", []).append(std)
+            eval_list.append(("cv_agg", f"{nm} {metric}", mean, bigger))
+        try:
+            for cb in cbs_after:
+                cb(CallbackEnv(cvb, params, it, 0, num_boost_round,
+                               eval_list))
+        except EarlyStopException as e:
+            cvb.best_iteration = e.best_iteration + 1
+            for k in list(results):
+                results[k] = results[k][:cvb.best_iteration]
+            for bst in cvb.boosters:
+                bst.best_iteration = cvb.best_iteration
+            break
+        if finished:
+            break
     if return_cvbooster:
         results["cvbooster"] = cvb
     return results

@@ -1,0 +1,150 @@
+"""cv()/CVBooster coverage (reference engine.py:625 cv + test_engine.py
+cv cases: stratified folds, group-aware folds, early stopping on the
+aggregated metric, eval_train_metric, return_cvbooster, custom folds)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _bin_data(rng, n=1200):
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] + 0.6 * X[:, 1] ** 2 + rng.normal(scale=0.4, size=n)
+         > 0.4).astype(float)
+    return X, y
+
+
+def test_cv_basic_metrics_shape(rng):
+    X, y = _bin_data(rng)
+    res = lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 7,
+                  "verbosity": -1},
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=8, nfold=3, seed=1)
+    assert set(res) == {"valid auc-mean", "valid auc-stdv"}
+    assert len(res["valid auc-mean"]) == 8
+    assert res["valid auc-mean"][-1] > 0.85
+    assert all(s >= 0 for s in res["valid auc-stdv"])
+
+
+def test_cv_stratified_balances_folds(rng):
+    X, y = _bin_data(rng)
+    y[:] = 0.0
+    y[:120] = 1.0  # 10% positives
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "num_leaves": 7, "verbosity": -1}, ds,
+                 num_boost_round=5, nfold=4, stratified=True, seed=3,
+                 return_cvbooster=True)
+    # every fold's VALID shard must contain positives (stratification);
+    # with 10% positives an unstratified shuffle can starve a fold
+    for bst in res["cvbooster"].boosters:
+        vy = bst._valid_sets[0].get_label()
+        assert 0.05 < vy.mean() < 0.2, vy.mean()
+
+
+def test_cv_group_aware_folds(rng):
+    nq, per = 40, 12
+    n = nq * per
+    X = rng.normal(size=(n, 5))
+    rel = (X[:, 0] > 0).astype(float) * 2 + (X[:, 1] > 0.4)
+    grp = np.full(nq, per)
+    ds = lgb.Dataset(X, label=rel, group=grp, free_raw_data=False)
+    res = lgb.cv({"objective": "lambdarank", "metric": "ndcg",
+                  "eval_at": [5], "num_leaves": 7, "verbosity": -1},
+                 ds, num_boost_round=5, nfold=4, seed=7,
+                 return_cvbooster=True)
+    assert "valid ndcg@5-mean" in res
+    # queries stay whole: each fold's valid rows are a multiple of per
+    for bst in res["cvbooster"].boosters:
+        assert bst._valid_sets[0].num_data % per == 0
+
+
+def test_cv_early_stopping_aggregated(rng):
+    X, y = _bin_data(rng)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    res = lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 7,
+                  "verbosity": -1, "learning_rate": 0.3}, ds,
+                 num_boost_round=200, nfold=3, seed=5,
+                 callbacks=[lgb.early_stopping(5, verbose=False)],
+                 return_cvbooster=True)
+    cvb = res["cvbooster"]
+    # stopped well before 200 rounds, results truncated to best_iteration
+    assert 0 < cvb.best_iteration < 200
+    assert len(res["valid auc-mean"]) == cvb.best_iteration
+    assert all(b.best_iteration == cvb.best_iteration
+               for b in cvb.boosters)
+
+
+def test_cv_eval_train_metric(rng):
+    X, y = _bin_data(rng)
+    res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "num_leaves": 7, "verbosity": -1},
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=5, nfold=3, eval_train_metric=True)
+    assert "train binary_logloss-mean" in res
+    assert "valid binary_logloss-mean" in res
+    # train loss below valid loss by the end (it always overfits a bit)
+    assert res["train binary_logloss-mean"][-1] \
+        <= res["valid binary_logloss-mean"][-1] + 1e-9
+
+
+def test_cv_custom_folds_and_return_cvbooster(rng):
+    X, y = _bin_data(rng, n=900)
+    idx = np.arange(900)
+    folds = [(idx[300:], idx[:300]), (np.concatenate([idx[:300],
+                                                      idx[600:]]),
+              idx[300:600]), (idx[:600], idx[600:])]
+    res = lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 7,
+                  "verbosity": -1},
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=4, folds=folds, return_cvbooster=True)
+    cvb = res["cvbooster"]
+    assert len(cvb.boosters) == 3
+    # CVBooster broadcasts method calls to every fold booster
+    preds = cvb.predict(X)
+    assert len(preds) == 3 and all(p.shape == (900,) for p in preds)
+    for bst, (tr, te) in zip(cvb.boosters, folds):
+        assert bst.train_set.num_data == len(tr)
+
+
+def test_cv_record_evaluation_callback(rng):
+    X, y = _bin_data(rng)
+    hist = {}
+    lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 7,
+            "verbosity": -1},
+           lgb.Dataset(X, label=y, free_raw_data=False),
+           num_boost_round=6, nfold=3,
+           callbacks=[lgb.record_evaluation(hist)])
+    assert "cv_agg" in hist
+    assert len(hist["cv_agg"]["valid auc"]) == 6
+
+
+def test_cv_early_stopping_via_param(rng):
+    """early_stopping_rounds in params (not an explicit callback) must
+    arm cv early stopping, like train() does."""
+    X, y = _bin_data(rng)
+    res = lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 7,
+                  "verbosity": -1, "learning_rate": 0.3,
+                  "early_stopping_rounds": 5},
+                 lgb.Dataset(X, label=y, free_raw_data=False),
+                 num_boost_round=200, nfold=3, seed=5,
+                 return_cvbooster=True)
+    assert 0 < res["cvbooster"].best_iteration < 200
+    assert len(res["valid auc-mean"]) == res["cvbooster"].best_iteration
+
+
+def test_cv_on_pandas_categorical(rng):
+    pd = pytest.importorskip("pandas")
+    n = 900
+    colors = np.array(["a", "b", "c", "d"])
+    c = rng.randint(0, 4, size=n)
+    df = pd.DataFrame({"cat": pd.Categorical(colors[c]),
+                       "x": rng.normal(size=n)})
+    y = ((c % 2) + 0.3 * df["x"].to_numpy()
+         + 0.2 * rng.normal(size=n) > 0.5).astype(float)
+    res = lgb.cv({"objective": "binary", "metric": "auc", "num_leaves": 7,
+                  "verbosity": -1, "min_data_per_group": 5},
+                 lgb.Dataset(df, label=y, free_raw_data=False),
+                 num_boost_round=6, nfold=3)
+    assert res["valid auc-mean"][-1] > 0.7

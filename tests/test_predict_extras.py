@@ -157,3 +157,54 @@ def test_pandas_valid_set_uses_train_categories(rng):
               callbacks=[lgb.record_evaluation(evals)])
     final = evals["valid_0"]["l2"][-1]
     assert final < np.var(y[2000:]) * 0.3, final
+
+
+def test_pandas_int_categories_binary_roundtrip(rng, tmp_path):
+    """Integer category levels must survive the binary dataset cache
+    with their type (a stringified roundtrip would NaN every code)."""
+    n = 1200
+    codes = rng.randint(0, 5, size=n)
+    levels = np.array([10, 20, 30, 40, 50])
+    df = pd.DataFrame({"c": pd.Categorical(levels[codes],
+                                           categories=levels),
+                       "x": rng.normal(size=n)})
+    y = codes.astype(float) + 0.2 * rng.normal(size=n)
+    ds = lgb.Dataset(df, label=y, params={"min_data_per_group": 5})
+    ds.construct()
+    f = str(tmp_path / "intcat.bin")
+    ds.save_binary(f)
+    ds2 = lgb.Dataset(f)
+    ds2.construct()
+    assert ds2.pandas_categorical == [[10, 20, 30, 40, 50]]
+    # a valid frame aligned against the reloaded train set still bins
+    dv = lgb.Dataset(df.iloc[:200], label=y[:200], reference=ds2)
+    dv.construct()
+    assert not np.isnan(dv.bins).any()
+
+
+def test_pandas_cat_frame_on_numpy_model_raises(rng):
+    """Predicting a categorical DataFrame on a model trained from a
+    plain matrix must raise the reference's mismatch error, not feed
+    frame-local codes."""
+    X = rng.normal(size=(600, 3))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 5)
+    df = pd.DataFrame({"a": pd.Categorical(["x", "y"] * 300),
+                       "b": np.zeros(600), "c": np.zeros(600)})
+    with pytest.raises(ValueError, match="do not match"):
+        bst.predict(df)
+
+
+def test_pred_early_stop_objective_alias(rng):
+    """Objective key/value aliases must still arm pred_early_stop."""
+    X = rng.normal(size=(2000, 5))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"application": "binary", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 30)
+    full = bst.predict(X, raw_score=True)
+    es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=1.0)
+    assert (np.abs(full - es) > 1e-3).any()

@@ -117,3 +117,129 @@ def test_class_weight_balanced(rng):
     rec_w = clf.predict(X)[y == 1].mean()
     rec_0 = clf0.predict(X)[y == 1].mean()
     assert rec_w >= rec_0
+
+
+def test_callable_eval_metric(rng):
+    """Callable eval_metric (reference test_sklearn.py
+    test_metrics/custom metric wrappers): (y_true, y_pred) ->
+    (name, value, is_higher_better)."""
+    X = rng.normal(size=(1500, 6))
+    y = X[:, 0] * 2 + 0.2 * rng.normal(size=1500)
+
+    def mape(y_true, y_pred):
+        v = np.mean(np.abs(y_true - y_pred) / (np.abs(y_true) + 1.0))
+        return "my_mape", float(v), False
+
+    reg = LGBMRegressor(n_estimators=15, num_leaves=15)
+    reg.fit(X[:1200], y[:1200], eval_set=[(X[1200:], y[1200:])],
+            eval_metric=mape)
+    hist = reg.evals_result_["valid_0"]["my_mape"]
+    assert len(hist) == 15
+    assert hist[-1] < hist[0]
+
+
+def test_early_stopping_in_fit_via_param(rng):
+    """early_stopping_rounds as an estimator param (no explicit
+    callback) must arm early stopping inside fit."""
+    X = rng.normal(size=(1500, 5))
+    y = (X[:, 0] > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=300, num_leaves=7,
+                         early_stopping_rounds=5)
+    clf.fit(X[:1200], y[:1200], eval_set=[(X[1200:], y[1200:])])
+    assert 0 < clf.best_iteration_ < 300
+    # best_iteration drives default predict slicing
+    full_pred = clf.predict_proba(X[1200:])[:, 1]
+    explicit = clf._Booster.predict(
+        X[1200:], num_iteration=clf.best_iteration_)
+    np.testing.assert_allclose(full_pred, explicit)
+
+
+def test_sample_weight_with_eval_set(rng):
+    """sample_weight + eval_sample_weight flow into the metric
+    (weighted l2 differs from unweighted)."""
+    X = rng.normal(size=(1600, 5))
+    y = X[:, 0] + 0.3 * rng.normal(size=1600)
+    w = np.where(X[:, 1] > 0, 5.0, 0.5)
+    reg_w = LGBMRegressor(n_estimators=10, num_leaves=15)
+    reg_w.fit(X[:1200], y[:1200], sample_weight=w[:1200],
+              eval_set=[(X[1200:], y[1200:])],
+              eval_sample_weight=[w[1200:]], eval_metric="l2")
+    reg_u = LGBMRegressor(n_estimators=10, num_leaves=15)
+    reg_u.fit(X[:1200], y[:1200],
+              eval_set=[(X[1200:], y[1200:])], eval_metric="l2")
+    h_w = reg_w.evals_result_["valid_0"]["l2"]
+    h_u = reg_u.evals_result_["valid_0"]["l2"]
+    assert not np.allclose(h_w, h_u)
+    assert not np.allclose(reg_w.predict(X), reg_u.predict(X))
+
+
+def test_custom_objective_callable(rng):
+    """objective=<callable> (reference sklearn custom fobj wrapper:
+    (y_true, y_pred) -> (grad, hess))."""
+    X = rng.normal(size=(1500, 5))
+    y = X[:, 0] + 0.2 * rng.normal(size=1500)
+
+    def l2_obj(y_true, y_pred):
+        return y_pred - y_true, np.ones_like(y_true)
+
+    reg = LGBMRegressor(n_estimators=20, num_leaves=15, objective=l2_obj)
+    reg.fit(X, y)
+    builtin = LGBMRegressor(n_estimators=20, num_leaves=15)
+    builtin.fit(X, y)
+    # same gradients as builtin l2 -> near-identical models (custom path
+    # skips boost_from_average, so compare fits, not raw equality)
+    m_c = np.mean((reg.predict(X) - y) ** 2)
+    m_b = np.mean((builtin.predict(X) - y) ** 2)
+    assert m_c < m_b * 1.5
+
+
+def test_multiple_eval_sets_and_names(rng):
+    X = rng.normal(size=(1800, 5))
+    y = (X[:, 0] > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=8, num_leaves=7)
+    clf.fit(X[:1000], y[:1000],
+            eval_set=[(X[1000:1400], y[1000:1400]),
+                      (X[1400:], y[1400:])],
+            eval_names=["dev", "holdout"], eval_metric="auc")
+    assert set(clf.evals_result_) == {"dev", "holdout"}
+    assert len(clf.evals_result_["dev"]["auc"]) == 8
+
+
+def test_fit_with_pandas_and_categoricals(rng):
+    pd = pytest.importorskip("pandas")
+    n = 1500
+    colors = np.array(["a", "b", "c", "d"])
+    c = rng.randint(0, 4, size=n)
+    df = pd.DataFrame({"cat": pd.Categorical(colors[c]),
+                       "x": rng.normal(size=n)})
+    y = (np.asarray([0.0, 2.0, -1.0, 1.0])[c]
+         + 0.3 * df["x"].to_numpy() + 0.1 * rng.normal(size=n))
+    reg = LGBMRegressor(n_estimators=15, num_leaves=15,
+                        min_data_per_group=5)
+    reg.fit(df, y)
+    r2 = 1 - np.mean((reg.predict(df) - y) ** 2) / np.var(y)
+    assert r2 > 0.9
+    assert list(reg.feature_name_) == ["cat", "x"]
+
+
+def test_init_model_continuation(rng):
+    X = rng.normal(size=(1500, 5))
+    y = X[:, 0] ** 2 + 0.2 * rng.normal(size=1500)
+    base = LGBMRegressor(n_estimators=10, num_leaves=15)
+    base.fit(X, y)
+    cont = LGBMRegressor(n_estimators=10, num_leaves=15)
+    cont.fit(X, y, init_model=base._Booster)
+    assert cont._Booster.num_trees() == 20
+    m_base = np.mean((base.predict(X) - y) ** 2)
+    m_cont = np.mean((cont.predict(X) - y) ** 2)
+    assert m_cont < m_base
+
+
+def test_regressor_score_and_classifier_score(rng):
+    X = rng.normal(size=(1000, 5))
+    y = X[:, 0] + 0.1 * rng.normal(size=1000)
+    reg = LGBMRegressor(n_estimators=15, num_leaves=15).fit(X, y)
+    assert reg.score(X, y) > 0.9           # sklearn R^2 protocol
+    yc = (y > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=15, num_leaves=15).fit(X, yc)
+    assert clf.score(X, yc) > 0.9          # accuracy protocol
