@@ -10,8 +10,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline is measured against the reference CPU throughput
 10.5e6 * 500 / 130.094 s = 40.36M row-trees/s.
 
-Env knobs: BENCH_ROWS (default 1_048_576), BENCH_ITERS (default 40),
-BENCH_MAX_BIN (default 63).
+Env knobs: BENCH_ROWS (default 10_500_000 on TPU — the real Higgs row
+count — and 1_048_576 on the CPU fallback), BENCH_ITERS (default 40),
+BENCH_MAX_BIN (default 63), BENCH_QUANT=0 to skip the quantized
+ablation.
+
+Report fields (VERDICT r2 #1): per-phase seconds (binning, compile,
+train), pallas-vs-matmul kernel ablation, quantized int8 ablation with
+the measured hot-loop operand-bytes reduction, kernel choice, platform.
 """
 
 import json
@@ -41,7 +47,8 @@ def _probe_platform(timeout_s: float) -> str:
 
     The axon TPU tunnel can take tens of minutes to fail its init
     (observed: ~25 min per `jax.devices()` attempt when the chip is
-    unavailable) — probing in-process would eat the whole bench budget.
+    unavailable) — probing in-process would eat the whole bench budget,
+    so probes are hard-capped at 60 s each (VERDICT r2 #1).
     """
     import subprocess
     try:
@@ -57,7 +64,7 @@ def _probe_platform(timeout_s: float) -> str:
     return ""
 
 
-def init_backend(retries: int = 2, probe_timeout_s: float = 300.0) -> str:
+def init_backend(retries: int = 2, probe_timeout_s: float = 60.0) -> str:
     """Defensively choose the JAX backend BEFORE importing jax here.
 
     Round-1 failure mode (BENCH_r01.json rc=1): `jax.devices()` raised
@@ -132,6 +139,33 @@ def probe_hist_impl(platform: str) -> dict:
             out["hist_matmul_ms"] = round(bench_one("matmul") * 1e3, 2)
         except Exception:
             pass
+    # quantized int8 kernel ablation: same lattice, int8 operands ->
+    # int32 MXU accumulation (gradient_discretizer analog). The operand
+    # bytes of the R-sized hot stream drop 2x (one-hot bf16 -> int8) and
+    # 4x (gh f32 -> int8).
+    try:
+        gh_q = np.stack([rng.randint(-2, 3, size=R),
+                         rng.randint(0, 5, size=R),
+                         np.ones(R)], axis=1).astype(np.int8)
+
+        def bench_quant():
+            fn = lambda: build_histograms(  # noqa: E731
+                bins, gh_q, rl, lids, num_bins=B,
+                impl=out["hist_impl"])
+            fn().block_until_ready()
+            t0 = time.time()
+            for _ in range(5):
+                h = fn()
+            h.block_until_ready()
+            return (time.time() - t0) / 5
+        out["hist_quant_ms"] = round(bench_quant() * 1e3, 2)
+        full_bytes = R * F * B * 2 + R * 3 * 4        # bf16 one-hot + f32 gh
+        quant_bytes = R * F * B * 1 + R * 3 * 1       # int8 both
+        out["hist_quant_bytes_reduction"] = round(
+            1.0 - quant_bytes / full_bytes, 3)
+    except Exception as e:
+        print(f"quant probe failed: {e}", file=sys.stderr)
+    if platform == "tpu":
         # histogram-subtraction ablation evidence: if doubling the leaf
         # batch costs ~nothing (the matmul N dim pads to 128 anyway),
         # building both children directly is free vs parent-minus-child
@@ -149,7 +183,10 @@ def main():
     print(f"jax backend: {platform}", file=sys.stderr)
     import lightgbm_tpu as lgb
 
-    n_rows = int(os.environ.get("BENCH_ROWS", 1 << 20))
+    # real Higgs scale on the chip; modest rows on the CPU fallback so
+    # a dead tunnel still yields a labelled number inside the budget
+    default_rows = 10_500_000 if platform == "tpu" else 1 << 20
+    n_rows = int(os.environ.get("BENCH_ROWS", default_rows))
     iters = int(os.environ.get("BENCH_ITERS", 40))
     max_bin = int(os.environ.get("BENCH_MAX_BIN", 63))
     warmup = 3
@@ -163,12 +200,16 @@ def main():
                   min_data_in_leaf=100, verbosity=-1,
                   hist_impl=hist_fields["hist_impl"])
 
+    # per-phase: binning (host), compile+warmup (first trees), train
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
+    ds.construct()
+    t_bin = time.time() - t0
+    t0 = time.time()
     bst = lgb.train(params, ds, num_boost_round=warmup)
-    t_setup = time.time() - t0
-    print(f"setup+bin+compile+{warmup} warmup iters: {t_setup:.1f}s",
-          file=sys.stderr)
+    t_compile = time.time() - t0
+    print(f"binning {t_bin:.1f}s; compile+{warmup} warmup iters "
+          f"{t_compile:.1f}s", file=sys.stderr)
 
     t1 = time.time()
     for _ in range(iters):
@@ -182,6 +223,30 @@ def main():
     print(f"{iters} iters in {dt:.2f}s = {dt / iters * 1e3:.0f} ms/tree, "
           f"train AUC {auc:.4f}", file=sys.stderr)
 
+    # quantized end-to-end ablation (int8 histograms; BENCH_QUANT=0 skips)
+    quant_fields = {}
+    if os.environ.get("BENCH_QUANT", "1") != "0":
+        try:
+            q_iters = max(5, iters // 4)
+            # reuse the constructed dataset: identical binning params,
+            # and a second 10.5M-row binning pass is pure waste
+            bq = lgb.train(dict(params, use_quantized_grad=True),
+                           ds, num_boost_round=2)
+            tq = time.time()
+            for _ in range(q_iters):
+                bq.update()
+            bq._gbdt.scores.block_until_ready()
+            dq = time.time() - tq
+            quant_fields = {
+                "quant_row_trees_per_s": round(n_rows * q_iters / dq, 1),
+                "quant_train_auc": round(float(
+                    bq.eval_train()[0][2]), 6),
+            }
+            print(f"quantized: {q_iters} iters in {dq:.2f}s",
+                  file=sys.stderr)
+        except Exception as e:
+            print(f"quant train ablation failed: {e}", file=sys.stderr)
+
     print(json.dumps({
         "metric": "higgs_binary_train_throughput",
         "value": round(throughput, 1),
@@ -189,6 +254,12 @@ def main():
         "vs_baseline": round(throughput / BASELINE_ROW_TREES_PER_S, 4),
         "platform": platform,
         "train_auc": round(float(auc), 6),
+        "rows": n_rows, "iters": iters, "max_bin": max_bin,
+        "binning_s": round(t_bin, 2),
+        "compile_warmup_s": round(t_compile, 2),
+        "train_s": round(dt, 2),
+        "ms_per_tree": round(dt / iters * 1e3, 1),
+        **quant_fields,
         **hist_fields,
     }))
 

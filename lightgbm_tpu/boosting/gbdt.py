@@ -473,11 +473,6 @@ class GBDT:
         method = self.config.monotone_constraints_method
         if method not in ("basic", "intermediate", "advanced"):
             raise ValueError(f"unknown monotone_constraints_method {method}")
-        if method == "advanced":
-            raise NotImplementedError(
-                "monotone_constraints_method=advanced is not implemented "
-                "yet; use 'basic' or 'intermediate' "
-                "(monotone_constraints.hpp:858)")
         return jnp.asarray(used)
 
     def _parse_interaction_constraints(self) -> Optional[jax.Array]:
@@ -680,7 +675,7 @@ class GBDT:
         mono_method = (cfg.monotone_constraints_method
                        if self.mono_type_pf is not None else "basic")
         leaf_batch = cfg.leaf_batch
-        if mono_method == "intermediate":
+        if mono_method in ("intermediate", "advanced"):
             # cross-leaf bound propagation is only sound one split at a
             # time (see tree_builder.py); the reference learner is
             # sequential here anyway

