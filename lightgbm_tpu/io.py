@@ -159,6 +159,13 @@ def _load_sidecar(path: str, dtype) -> Optional[np.ndarray]:
 
 
 def _parse_delimited(lines: List[str], delim: str) -> np.ndarray:
+    # C fast path (native/parser.c, the src/io/parser.cpp analog);
+    # None means unavailable OR a bad token — re-parse in Python either
+    # way so errors carry the exact offending value
+    from .native import parse_delimited as _native_delim
+    fast = _native_delim(lines, delim)
+    if fast is not None:
+        return fast
     rows = [ln.split(delim) for ln in lines]
     width = max(len(r) for r in rows)
     out = np.full((len(rows), width), np.nan, dtype=np.float64)
@@ -177,6 +184,10 @@ def _parse_libsvm(lines: List[str], num_features_hint: int = 0):
     The reference treats absent LibSVM entries as zero (sparse storage);
     we densify with 0.0, matching prediction/training semantics.
     """
+    from .native import parse_libsvm as _native_libsvm
+    fast = _native_libsvm(lines, num_features_hint)
+    if fast is not None:
+        return fast
     labels = np.empty(len(lines), dtype=np.float64)
     idx_rows, val_rows = [], []
     max_idx = num_features_hint - 1

@@ -1,0 +1,102 @@
+"""Multi-process training launcher — the orchestration analog of the
+reference's Dask integration (``python-package/lightgbm/dask.py:415``
+``_train``: find workers, open ports, build the ``machines`` string, run
+one network-initialized training per worker) and of ``mpirun`` for the
+MPI build. Here the per-worker "network init" is
+``jax.distributed.initialize``, so the launcher only has to pick a
+coordinator port, spawn N copies of the user's script with rank
+environment variables, and fail fast if any worker dies (the
+reference's collectives are fail-fast too, SURVEY.md §5).
+
+Usage::
+
+    python -m lightgbm_tpu.launch -n 4 train_script.py [script args...]
+
+Each worker sees ``LIGHTGBM_TPU_COORDINATOR``, ``LIGHTGBM_TPU_RANK``
+and ``LIGHTGBM_TPU_NUM_PROCESSES``; a script that calls
+``lightgbm_tpu.parallel.distributed.init_distributed()`` (or trains
+with ``num_machines`` > 1) picks them up automatically. On Cloud TPU
+pods, prefer the platform launcher + jax.distributed auto-detection —
+this launcher is for single-host multi-process setups (CPU meshes,
+tests) and explicit host lists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch(script_argv: List[str], num_processes: int,
+           coordinator: Optional[str] = None) -> int:
+    """Spawn ``num_processes`` workers; returns the first nonzero exit
+    code (killing the stragglers, fail-fast) or 0."""
+    if num_processes < 1:
+        raise ValueError("num_processes must be >= 1")
+    coord = coordinator or f"127.0.0.1:{_free_port()}"
+    procs = []
+    try:
+        for rank in range(num_processes):
+            env = dict(os.environ)
+            env["LIGHTGBM_TPU_COORDINATOR"] = coord
+            env["LIGHTGBM_TPU_RANK"] = str(rank)
+            env["LIGHTGBM_TPU_NUM_PROCESSES"] = str(num_processes)
+            procs.append(subprocess.Popen(
+                [sys.executable] + list(script_argv), env=env))
+        # poll ALL workers: a rank-order wait would block on rank 0
+        # while a later rank has already died, defeating fail-fast
+        rc = 0
+        alive = list(procs)
+        while alive:
+            for p in list(alive):
+                code = p.poll()
+                if code is None:
+                    continue
+                alive.remove(p)
+                if code != 0 and rc == 0:
+                    rc = code
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+            if alive:
+                time.sleep(0.1)
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu.launch",
+        description="Run a training script as N coordinated processes")
+    ap.add_argument("-n", "--num-processes", type=int, required=True)
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port (default: 127.0.0.1:<free port>)")
+    ap.add_argument("script", help="python script to run per worker")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(argv)
+    return launch([ns.script] + ns.args, ns.num_processes,
+                  ns.coordinator)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

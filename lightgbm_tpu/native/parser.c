@@ -1,0 +1,190 @@
+/* Native text parser — the C data-loader core.
+ *
+ * Analog of the reference's C++ parser layer (src/io/parser.cpp
+ * CSVParser/TSVParser/LibSVMParser + Common::Atof): the Python loader
+ * (lightgbm_tpu/io.py) handles format detection, headers, and metadata
+ * columns, and hands the joined data body here for the byte-crunching
+ * inner loops. Every function returns an error code instead of raising;
+ * the Python caller falls back to its own (slower) parser to produce
+ * the exact error message, so behavior is identical either way.
+ *
+ * Built at runtime with `gcc -O3 -shared -fPIC` (see native/__init__.py)
+ * — no build step at install time, no hard dependency: if gcc or the
+ * compile is unavailable the Python paths serve alone.
+ */
+
+#include <math.h>
+#include <stdlib.h>
+#include <string.h>
+
+/* max columns over newline-joined, pre-stripped lines */
+long lgbtpu_max_cols(const char *buf, long nbytes, char delim) {
+    long mx = 0, c = 1;
+    for (long i = 0; i < nbytes; i++) {
+        if (buf[i] == delim) {
+            c++;
+        } else if (buf[i] == '\n') {
+            if (c > mx) mx = c;
+            c = 1;
+        }
+    }
+    if (nbytes > 0 && c > mx) mx = c;
+    return mx;
+}
+
+/* strict=1 matches bare Python float(): no NA aliases, empty rejected
+ * (the LibSVM fallback parser uses plain float()); strict=0 matches the
+ * CSV fallback's NA handling. Hex floats are rejected in both modes —
+ * strtod accepts them but Python float() does not, and the two paths
+ * must agree byte-for-byte. */
+static int token_value_mode(const char *a, const char *b, double *out,
+                            int strict) {
+    /* trim surrounding spaces/tabs */
+    while (a < b && (*a == ' ' || *a == '\t')) a++;
+    while (b > a && (b[-1] == ' ' || b[-1] == '\t')) b--;
+    long len = b - a;
+    if (len == 0) {
+        *out = NAN;
+        return strict;
+    }
+    if (!strict
+        && ((len == 2 && (!strncmp(a, "na", 2) || !strncmp(a, "NA", 2)))
+            || (len == 3 && (!strncmp(a, "nan", 3)
+                             || !strncmp(a, "NaN", 3)))
+            || (len == 4 && (!strncmp(a, "null", 4)
+                             || !strncmp(a, "None", 4))))) {
+        *out = NAN;
+        return 0;
+    }
+    for (long i = 0; i < len; i++)
+        if (a[i] == 'x' || a[i] == 'X') return 1;  /* no hex floats */
+    if (len >= 63) return 1;
+    char tmp[64];
+    memcpy(tmp, a, len);
+    tmp[len] = 0;
+    char *endp;
+    *out = strtod(tmp, &endp);
+    return endp != tmp + len;
+}
+
+static int token_value(const char *a, const char *b, double *out) {
+    return token_value_mode(a, b, out, 0);
+}
+
+/* CSV/TSV body -> row-major doubles. `out` must be pre-filled with NaN
+ * (ragged short rows keep NaN, matching the Python parser). Returns 0
+ * on success, 1 on any bad token / too-wide row (caller falls back). */
+int lgbtpu_parse_delimited(const char *buf, long nbytes, char delim,
+                           long nrows, long ncols, double *out) {
+    const char *p = buf;
+    const char *end = buf + nbytes;
+    long r = 0;
+    while (p < end && r < nrows) {
+        long c = 0;
+        for (;;) {
+            const char *q = p;
+            while (q < end && *q != delim && *q != '\n') q++;
+            double v;
+            if (token_value(p, q, &v)) return 1;
+            if (c >= ncols) return 1;
+            out[r * ncols + c] = v;
+            c++;
+            if (q >= end || *q == '\n') {
+                p = q < end ? q + 1 : end;
+                break;
+            }
+            p = q + 1;
+        }
+        r++;
+    }
+    return r == nrows ? 0 : 1;
+}
+
+/* LibSVM pass 1: max feature index over `label idx:val ...` lines.
+ * Tokens without ':' after the label are skipped (same as the Python
+ * parser). Returns -2 on parse error, else the max index (-1 if none).
+ */
+long lgbtpu_libsvm_max_index(const char *buf, long nbytes) {
+    const char *p = buf;
+    const char *end = buf + nbytes;
+    long mx = -1;
+    while (p < end) {
+        int first = 1;
+        while (p < end && *p != '\n') {
+            while (p < end && (*p == ' ' || *p == '\t')) p++;
+            const char *q = p;
+            while (q < end && *q != ' ' && *q != '\t' && *q != '\n') q++;
+            if (q > p) {
+                if (first) {
+                    first = 0; /* label token, validated in pass 2 */
+                } else {
+                    const char *colon = memchr(p, ':', q - p);
+                    if (colon) {
+                        char tmp[32];
+                        long len = colon - p;
+                        if (len <= 0 || len >= 31) return -2;
+                        memcpy(tmp, p, len);
+                        tmp[len] = 0;
+                        char *endp;
+                        long idx = strtol(tmp, &endp, 10);
+                        if (endp != tmp + len || idx < 0) return -2;
+                        if (idx > mx) mx = idx;
+                    }
+                }
+            }
+            p = q;
+        }
+        if (p < end) p++; /* consume newline */
+    }
+    return mx;
+}
+
+/* LibSVM pass 2: labels [nrows] + dense out [nrows * ncols] (caller
+ * pre-zeroes out). Returns 0 ok, 1 on parse error. */
+int lgbtpu_parse_libsvm(const char *buf, long nbytes, long nrows,
+                        long ncols, double *labels, double *out) {
+    const char *p = buf;
+    const char *end = buf + nbytes;
+    long r = 0;
+    while (p < end && r < nrows) {
+        int first = 1;
+        while (p < end && *p != '\n') {
+            while (p < end && (*p == ' ' || *p == '\t')) p++;
+            const char *q = p;
+            while (q < end && *q != ' ' && *q != '\t' && *q != '\n') q++;
+            if (q > p) {
+                if (first) {
+                    double v;
+                    if (token_value_mode(p, q, &v, 1)) return 1;
+                    labels[r] = v;
+                    first = 0;
+                } else {
+                    const char *colon = memchr(p, ':', q - p);
+                    if (colon) {
+                        char tmp[64];
+                        long klen = colon - p;
+                        long vlen = q - colon - 1;
+                        if (klen <= 0 || klen >= 31 || vlen <= 0
+                            || vlen >= 63)
+                            return 1;
+                        memcpy(tmp, p, klen);
+                        tmp[klen] = 0;
+                        char *endp;
+                        long idx = strtol(tmp, &endp, 10);
+                        if (endp != tmp + klen || idx < 0 || idx >= ncols)
+                            return 1;
+                        double v;
+                        if (token_value_mode(colon + 1, q, &v, 1))
+                            return 1;
+                        out[r * ncols + idx] = v;
+                    }
+                }
+            }
+            p = q;
+        }
+        if (first) return 1; /* blank line should not reach here */
+        if (p < end) p++;
+        r++;
+    }
+    return r == nrows ? 0 : 1;
+}

@@ -37,13 +37,29 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      process_id: Optional[int] = None) -> None:
     """Bring up the multi-host JAX runtime (idempotent).
 
-    With no arguments, defers entirely to jax.distributed's
-    auto-detection (TPU pod metadata / env vars) — the normal path on
-    Cloud TPU slices.
+    With no arguments: first honors the ``lightgbm_tpu.launch``
+    environment (LIGHTGBM_TPU_COORDINATOR/_RANK/_NUM_PROCESSES — the
+    dask.py `machines` string analog), then defers to jax.distributed's
+    auto-detection (TPU pod metadata) — the normal path on Cloud TPU
+    slices.
     """
     global _initialized
     if _initialized:
         return
+    env_coord = os.environ.get("LIGHTGBM_TPU_COORDINATOR")
+    env_n = os.environ.get("LIGHTGBM_TPU_NUM_PROCESSES")
+    env_rank = os.environ.get("LIGHTGBM_TPU_RANK")
+    if coordinator_address is None and env_coord:
+        if env_n is None or env_rank is None:
+            raise ValueError(
+                "LIGHTGBM_TPU_COORDINATOR requires "
+                "LIGHTGBM_TPU_NUM_PROCESSES and LIGHTGBM_TPU_RANK too "
+                "(the lightgbm_tpu.launch launcher sets all three)")
+        coordinator_address = env_coord
+        if num_processes is None:
+            num_processes = int(env_n)
+    if process_id is None and env_rank is not None:
+        process_id = int(env_rank)
     import jax
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,

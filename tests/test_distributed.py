@@ -187,3 +187,59 @@ def test_two_process_data_parallel_training(tmp_path):
 @pytest.mark.slow
 def test_two_process_auto_partition_training(tmp_path):
     _run_two_workers(tmp_path, "auto")
+
+
+_LAUNCH_WORKER = textwrap.dedent("""
+    import os, sys
+    outdir, repo = sys.argv[1], sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from lightgbm_tpu.parallel.distributed import init_distributed
+    init_distributed()          # picks up the launcher's env vars
+    assert jax.process_count() == 2
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(1200, 4))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "tree_learner": "data", "verbosity": -1,
+                     "min_data_in_leaf": 5}, lgb.Dataset(X, label=y), 4)
+    rank = jax.process_index()
+    with open(os.path.join(outdir, f"launch_{rank}.txt"), "w") as f:
+        f.write(bst.model_to_string())
+""")
+
+
+@pytest.mark.slow
+def test_launcher_spawns_coordinated_workers(tmp_path):
+    """python -m lightgbm_tpu.launch (the dask.py orchestration analog):
+    workers coordinate via env vars and train the identical model."""
+    from lightgbm_tpu.launch import launch
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "lw.py"
+    script.write_text(_LAUNCH_WORKER)
+    env_clean = {k: v for k, v in os.environ.items()
+                 if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    old = dict(os.environ)
+    os.environ.clear()
+    os.environ.update(env_clean)
+    try:
+        rc = launch([str(script), str(tmp_path), repo], num_processes=2)
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+    assert rc == 0
+    m0 = (tmp_path / "launch_0.txt").read_text()
+    m1 = (tmp_path / "launch_1.txt").read_text()
+    assert m0 == m1
+
+
+def test_launcher_fail_fast(tmp_path):
+    from lightgbm_tpu.launch import launch
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; sys.exit(3)\n")
+    rc = launch([str(bad)], num_processes=2)
+    assert rc == 3

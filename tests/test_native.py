@@ -1,0 +1,95 @@
+"""Native C parser (lightgbm_tpu/native/parser.c — the src/io/parser.cpp
+analog): exact parity with the Python fallback, graceful degradation."""
+
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import native
+
+
+def _fresh(disable: bool):
+    native._TRIED = False
+    native._LIB = None
+    if disable:
+        os.environ["LIGHTGBM_TPU_NO_NATIVE"] = "1"
+    else:
+        os.environ.pop("LIGHTGBM_TPU_NO_NATIVE", None)
+
+
+@pytest.fixture(autouse=True)
+def _restore_native():
+    yield
+    _fresh(disable=False)
+
+
+def test_native_lib_builds():
+    _fresh(disable=False)
+    assert native.native_lib() is not None, \
+        "gcc is present in this environment; the native parser must build"
+
+
+def test_delimited_parity_with_python(rng):
+    truth = rng.normal(size=(2000, 9)).round(6)
+    lines = []
+    for i, row in enumerate(truth):
+        toks = [f"{v:g}" for v in row]
+        if i % 5 == 0:
+            toks[2] = "NA"
+        if i % 9 == 0:
+            toks[7] = ""
+        lines.append(",".join(toks))
+    _fresh(disable=False)
+    fast = native.parse_delimited(lines, ",")
+    assert fast is not None
+    _fresh(disable=True)
+    from lightgbm_tpu.io import _parse_delimited
+    slow = _parse_delimited(lines, ",")
+    np.testing.assert_array_equal(np.isnan(fast), np.isnan(slow))
+    np.testing.assert_allclose(np.nan_to_num(fast), np.nan_to_num(slow))
+
+
+def test_libsvm_parity_with_python(rng):
+    lines = []
+    for i in range(1500):
+        idxs = sorted(rng.choice(30, 4, replace=False))
+        lines.append(f"{i % 3} " + " ".join(
+            f"{k}:{rng.normal():.5f}" for k in idxs))
+    _fresh(disable=False)
+    out = native.parse_libsvm(lines, num_features_hint=35)
+    assert out is not None
+    lab_f, X_f = out
+    _fresh(disable=True)
+    from lightgbm_tpu.io import _parse_libsvm
+    lab_s, X_s = _parse_libsvm(lines, num_features_hint=35)
+    np.testing.assert_allclose(lab_f, lab_s)
+    np.testing.assert_allclose(X_f, X_s)
+    assert X_f.shape[1] == 35
+
+
+def test_bad_token_falls_back_to_python_error(tmp_path):
+    # native parser rejects, Python fallback raises the detailed error
+    f = tmp_path / "bad.train"
+    f.write_text("1\t0.5\toops\n0\t0.1\t0.2\n")
+    _fresh(disable=False)
+    from lightgbm_tpu.io import load_data_file
+    with pytest.raises(ValueError):
+        load_data_file(str(f))
+
+
+def test_end_to_end_file_training_uses_native(tmp_path, rng):
+    X = rng.normal(size=(800, 5))
+    y = (X[:, 0] > 0).astype(int)
+    data = tmp_path / "t.train"
+    np.savetxt(str(data), np.column_stack([y, X]), delimiter="\t",
+               fmt="%.6f")
+    _fresh(disable=False)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(str(data)), 5)
+    p_native = bst.predict(X)
+    _fresh(disable=True)
+    bst2 = lgb.train({"objective": "binary", "num_leaves": 7,
+                      "verbosity": -1}, lgb.Dataset(str(data)), 5)
+    np.testing.assert_allclose(p_native, bst2.predict(X))
