@@ -352,10 +352,22 @@ class Dataset:
                                        self.num_data)
         else:
             dtype = np.uint8 if self.max_num_bin <= 256 else np.int32
-            self.bins = np.empty((self.num_data, F), dtype=dtype)
-            for j, f in enumerate(self.used_features):
-                self.bins[:, j] = self.bin_mappers[f].values_to_bins(
-                    col_of(f)).astype(dtype)
+            fast = None
+            if not sparse:
+                # accelerator fast path: one jitted searchsorted over the
+                # whole [R, F] matrix (ops/binning_device.py)
+                from .ops.binning_device import (device_bin_dense,
+                                                 want_device_binning)
+                if want_device_binning(self.num_data, F):
+                    fast = device_bin_dense(
+                        data, self.bin_mappers, self.used_features, dtype)
+            if fast is not None:
+                self.bins = fast
+            else:
+                self.bins = np.empty((self.num_data, F), dtype=dtype)
+                for j, f in enumerate(self.used_features):
+                    self.bins[:, j] = self.bin_mappers[f].values_to_bins(
+                        col_of(f)).astype(dtype)
 
         if self.label is None and not self.params.get("_allow_no_label"):
             raise ValueError("Dataset has no label")

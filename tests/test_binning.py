@@ -111,3 +111,44 @@ def test_threshold_value_roundtrip():
         lhs = v <= thr
         rhs = bins <= t
         assert (lhs == rhs).all()
+
+
+def test_device_binning_parity(rng, monkeypatch):
+    """ops/binning_device: the jitted searchsorted path must agree with
+    the host BinMapper mapping (away from f32-eps boundary cases)."""
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "1")
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(3000, 6)).round(3)  # rounded: off f32 edges
+    X[::13, 2] = np.nan
+    y = rng.rand(3000)
+    ds_dev = lgb.Dataset(X, label=y, params={"max_bin": 63}).construct()
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "0")
+    ds_host = lgb.Dataset(X, label=y, params={"max_bin": 63}).construct()
+    np.testing.assert_array_equal(ds_dev.bins, ds_host.bins)
+
+
+def test_device_binning_mixed_categorical_parity(rng, monkeypatch):
+    """Mixed frames: numerical block on device, categorical columns via
+    the host mapper — identical to the all-host path."""
+    import lightgbm_tpu as lgb
+    X = np.column_stack([rng.randint(0, 5, size=800).astype(float),
+                         rng.normal(size=(800, 3)).round(3)])
+    y = rng.rand(800)
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "1")
+    dev = lgb.Dataset(X, label=y, categorical_feature=[0]).construct()
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "0")
+    host = lgb.Dataset(X, label=y, categorical_feature=[0]).construct()
+    np.testing.assert_array_equal(dev.bins, host.bins)
+
+
+def test_device_binning_declines_f32_overflow(rng, monkeypatch):
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "1")
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(600, 3))
+    X[:200, 1] = rng.choice([1e39, 2e39, -5e40], size=200)  # beyond f32
+    y = rng.rand(600)
+    dev = lgb.Dataset(X, label=y).construct()
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_BIN", "0")
+    host = lgb.Dataset(X, label=y).construct()
+    # the device path must decline and defer to the exact f64 host path
+    np.testing.assert_array_equal(dev.bins, host.bins)
