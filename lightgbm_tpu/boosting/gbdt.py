@@ -537,11 +537,18 @@ class GBDT:
         real = (self.train_dd.row_leaf0 >= 0).astype(jnp.float32)
         # padded rows DO carry gradients (label 0 vs init score) — mask them
         # out of the ranking or they displace real rows from the top set
-        score = jnp.sum(jnp.abs(g * h), axis=0) * real
+        # padded rows must be UNSELECTABLE, not merely zero-scored: a
+        # real row tied at 0 could otherwise lose its top slot to a
+        # lower-index padded row (multi-host padding sits at each
+        # host's local tail, below later hosts' real rows)
+        score = jnp.where(real > 0,
+                          jnp.sum(jnp.abs(g * h), axis=0), -jnp.inf)
         top_k = max(1, int(n_real * cfg.top_rate))
         other_k = max(1, int(n_real * cfg.other_rate))
-        kth = jnp.sort(score)[R - top_k]  # padded rows score 0, sink low
-        is_top = score >= kth
+        # exact arg-partition (goss.hpp:30 ArgMaxAtK): lax.top_k keeps
+        # exactly top_k rows even on tied scores
+        _, top_idx = jax.lax.top_k(score, top_k)
+        is_top = jnp.zeros((R,), bool).at[top_idx].set(True)
         u = jax.random.uniform(key, (R,))
         rest = ~is_top & (self.train_dd.row_leaf0 >= 0)
         p_keep = other_k / max(1, n_real - top_k)

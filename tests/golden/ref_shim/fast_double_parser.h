@@ -1,0 +1,12 @@
+/* strtod shim for the vendored fast_double_parser (external_libs empty
+ * in this checkout); numerically identical, just slower. */
+#pragma once
+#include <cstdlib>
+namespace fast_double_parser {
+inline const char* parse_number(const char* p, double* out) {
+  char* end;
+  *out = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  return end;
+}
+}  // namespace fast_double_parser

@@ -1,0 +1,30 @@
+/* snprintf shim for the vendored {fmt} (external_libs empty in this
+ * checkout). Supports exactly the three format strings common.h uses:
+ * "{}", "{:g}", "{:.17g}". */
+#pragma once
+#include <cstdio>
+#include <cstring>
+#include <cstdint>
+#include <string>
+namespace fmt {
+struct format_to_n_result { char* out; size_t size; };
+inline format_to_n_result format_to_n(char* buf, size_t n, const char* f,
+                                      double v) {
+  const char* s = "%g";
+  if (!std::strcmp(f, "{:.17g}")) s = "%.17g";
+  else if (!std::strcmp(f, "{:g}")) s = "%g";
+  else if (!std::strcmp(f, "{}")) s = "%g";
+  int r = std::snprintf(buf, n, s, v);
+  return {buf + (r < (int)n ? r : n), (size_t)r};
+}
+inline format_to_n_result format_to_n(char* buf, size_t n, const char* f,
+                                      float v) {
+  return format_to_n(buf, n, f, (double)v);
+}
+template <typename T>
+inline format_to_n_result format_to_n(char* buf, size_t n, const char*,
+                                      T v) {
+  int r = std::snprintf(buf, n, "%lld", (long long)v);
+  return {buf + (r < (int)n ? r : n), (size_t)r};
+}
+}  // namespace fmt

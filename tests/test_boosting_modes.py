@@ -145,3 +145,32 @@ def test_goss_boosting_alias(rng):
     bst = lgb.train({"objective": "binary", "boosting": "goss",
                      "num_leaves": 15, "verbosity": -1}, ds, 15)
     assert ((bst.predict(X) > 0.5) == y).mean() > 0.85
+
+
+def test_goss_exact_top_k_on_ties(rng):
+    """GOSS keeps EXACTLY top_rate*n rows even when gradient magnitudes
+    tie (goss.hpp:30 arg-partition semantics; the old threshold-rank
+    formulation admitted every tied row)."""
+    import jax
+    import lightgbm_tpu as lgb
+    n = 1000
+    X = rng.normal(size=(n, 4))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1, "data_sample_strategy": "goss",
+                     "top_rate": 0.2, "other_rate": 0.1,
+                     "learning_rate": 1.0},  # warmup skip = 1 iter
+                    lgb.Dataset(X, label=y, free_raw_data=False), 1)
+    gb = bst._gbdt
+    R = gb.train_dd.r_pad
+    # massive ties: every row has the same |g*h|
+    g = jax.numpy.ones((1, R))
+    h = jax.numpy.ones((1, R))
+    _, _, mask = gb._goss_jit(g, h, jax.random.PRNGKey(0))
+    n_top_expected = max(1, int(gb._num_data_global * 0.2))
+    # mask = top rows + sampled others; sampled fraction is random, so
+    # bound it: total in [top, top + 3 * other_k]
+    total = int(mask.sum())
+    other_k = max(1, int(gb._num_data_global * 0.1))
+    assert n_top_expected <= total <= n_top_expected + 3 * other_k, (
+        total, n_top_expected)

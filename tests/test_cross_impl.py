@@ -1,0 +1,97 @@
+"""Cross-implementation parity against the ACTUAL reference binary.
+
+Skipped unless ``.ref_build/lightgbm`` exists (build recipe:
+tests/golden/README.md). Direction 1: our v4 text models load in the
+reference CLI and reproduce our predictions. Direction 2: a
+reference-trained model loads in our Booster and reproduces the
+reference's predictions.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REF_BIN = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), ".ref_build", "lightgbm")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(REF_BIN),
+    reason="reference binary not built (.ref_build/lightgbm)")
+
+
+def _ref_predict(model_file, data_file, out_file):
+    subprocess.run(
+        [REF_BIN, "task=predict", f"data={data_file}",
+         f"input_model={model_file}", f"output_result={out_file}",
+         "verbosity=-1", "header=false"],
+        check=True, capture_output=True, timeout=300)
+    return np.loadtxt(out_file)
+
+
+def _roundtrip(bst, X, y, tmp_path, tag, atol=1e-9):
+    model = str(tmp_path / f"{tag}.txt")
+    data = str(tmp_path / f"{tag}.data")
+    outp = str(tmp_path / f"{tag}.pred")
+    bst.save_model(model)
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.9g")
+    ref = _ref_predict(model, data, outp)
+    ours = bst.predict(X)
+    np.testing.assert_allclose(ref, ours, rtol=1e-6, atol=atol)
+
+
+def test_reference_loads_our_numeric_model(rng, tmp_path):
+    X = rng.normal(size=(2000, 6)).round(4)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.4).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 10)
+    _roundtrip(bst, X, y, tmp_path, "numeric")
+
+
+def test_reference_loads_our_sorted_cat_model(rng, tmp_path):
+    """Sorted-subset categorical splits (this round's newly wired path)
+    must serialize into bitsets the reference traverses identically."""
+    ncat = 24
+    c = rng.randint(0, ncat, size=2500)
+    means = rng.normal(size=ncat) * 2
+    X = np.column_stack([c.astype(float), rng.normal(size=(2500, 3))])
+    y = means[c] + 0.4 * X[:, 1] + 0.1 * rng.normal(size=2500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_per_group": 5,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0],
+                                free_raw_data=False), 8)
+    assert any(t.num_cat > 0 for t in bst._all_trees())
+    _roundtrip(bst, X, y, tmp_path, "sortedcat")
+
+
+def test_reference_loads_our_quantized_model(rng, tmp_path):
+    X = rng.normal(size=(2000, 5)).round(4)
+    y = (X[:, 0] > 0.2).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "use_quantized_grad": True},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 10)
+    _roundtrip(bst, X, y, tmp_path, "quant")
+
+
+def test_we_load_reference_trained_model(rng, tmp_path):
+    """Reverse direction: train with the reference CLI, load its model
+    here, reproduce its own predictions."""
+    X = rng.normal(size=(3000, 5)).round(4)
+    y = (X[:, 0] - 0.6 * X[:, 1] ** 2 > 0).astype(float)
+    data = str(tmp_path / "ref.train")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.9g")
+    model = str(tmp_path / "ref_model.txt")
+    subprocess.run(
+        [REF_BIN, "task=train", f"data={data}", "objective=binary",
+         "num_leaves=15", "num_iterations=10", "min_data_in_leaf=20",
+         f"output_model={model}", "verbosity=-1"],
+        check=True, capture_output=True, timeout=300)
+    outp = str(tmp_path / "ref.pred")
+    ref_pred = _ref_predict(model, data, outp)
+    ours = lgb.Booster(model_file=model).predict(X)
+    np.testing.assert_allclose(ours, ref_pred, rtol=1e-6, atol=1e-9)

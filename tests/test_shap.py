@@ -87,3 +87,23 @@ def test_vectorized_contrib_categorical(rng):
         np.testing.assert_allclose(
             tree.predict_contrib(Xt), tree.predict_contrib_reference(Xt),
             rtol=1e-9, atol=1e-12)
+
+
+def test_shap_on_sorted_cat_model(rng):
+    """TreeSHAP over sorted-subset categorical splits: contributions
+    must still sum to the raw prediction (tree.h:141 local accuracy)."""
+    import lightgbm_tpu as lgb
+    ncat = 20
+    c = rng.randint(0, ncat, size=1500)
+    means = rng.normal(size=ncat) * 2
+    X = np.column_stack([c.astype(float), rng.normal(size=(1500, 2))])
+    y = means[c] + 0.3 * X[:, 1] + 0.1 * rng.normal(size=1500)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_per_group": 5,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0],
+                                free_raw_data=False), 8)
+    raw = bst.predict(X[:200], raw_score=True)
+    contrib = bst.predict(X[:200], pred_contrib=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-5, atol=1e-5)
