@@ -178,6 +178,51 @@ def probe_hist_impl(platform: str) -> dict:
     return out
 
 
+def ref_same_host_probe(X, y, iters, max_bin) -> dict:
+    """When the CPU fallback is what we're measuring, also time the
+    ACTUAL reference binary (if built — tests/golden/README.md) on the
+    same rows and host, single-threaded: the published 40.36M
+    row-trees/s baseline used 16 threads on a 28-core Xeon, so the
+    same-host single-core ratio is the honest CPU comparison. Bounded:
+    rows capped at 2^18 and the run at 120s."""
+    import subprocess
+    ref_bin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".ref_build", "lightgbm")
+    if not os.path.exists(ref_bin):
+        return {}
+    import shutil
+    import tempfile
+    tmpdir = tempfile.mkdtemp(prefix="bench_ref_")
+    try:
+        n = min(len(y), 1 << 18)
+        csv = os.path.join(tmpdir, "probe.csv")
+        np.savetxt(csv, np.column_stack([y[:n], X[:n]]), delimiter=",",
+                   fmt="%.6g")
+        out = subprocess.run(
+            [ref_bin, "task=train", f"data={csv}", "objective=binary",
+             "num_leaves=255", f"max_bin={max_bin}",
+             f"num_iterations={iters}", "learning_rate=0.1",
+             "min_data_in_leaf=100", "num_threads=1", "verbosity=1",
+             "output_model=" + os.path.join(tmpdir, "model.txt")],
+            capture_output=True, text=True, timeout=120)
+        train_s = None
+        for ln in out.stdout.splitlines():
+            if "seconds elapsed, finished iteration" in ln:
+                train_s = float(ln.split("]")[-1].strip().split(" ")[0])
+        if out.returncode != 0 or train_s is None:
+            print("same-host reference probe: reference run failed "
+                  f"(rc={out.returncode})", file=sys.stderr)
+            return {}
+        return {"ref_same_host_row_trees_per_s":
+                round(n * iters / train_s, 1),
+                "ref_same_host_rows": n}
+    except Exception as e:
+        print(f"same-host reference probe failed: {e}", file=sys.stderr)
+        return {}
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main():
     platform = init_backend()
     print(f"jax backend: {platform}", file=sys.stderr)
@@ -247,6 +292,9 @@ def main():
         except Exception as e:
             print(f"quant train ablation failed: {e}", file=sys.stderr)
 
+    ref_fields = (ref_same_host_probe(X, y, iters, max_bin)
+                  if platform == "cpu" else {})
+
     print(json.dumps({
         "metric": "higgs_binary_train_throughput",
         "value": round(throughput, 1),
@@ -260,6 +308,7 @@ def main():
         "train_s": round(dt, 2),
         "ms_per_tree": round(dt / iters * 1e3, 1),
         **quant_fields,
+        **ref_fields,
         **hist_fields,
     }))
 

@@ -263,3 +263,22 @@ def test_efb_composes_with_voting(rng):
     # the bundles must actually have formed, or this test is vacuous
     ds = lgb.Dataset(X, label=y).construct()
     assert ds.bundle_plan is not None
+
+
+def test_advanced_monotone_data_parallel_parity(rng):
+    """monotone_constraints_method=advanced under tree_learner=data:
+    the fresh per-candidate bounds derive only from replicated state
+    (tree outputs + boxes), so the sharded run must equal serial."""
+    import lightgbm_tpu as lgb
+    X = rng.uniform(-1, 1, size=(1536, 3))
+    y = 3 * X[:, 0] + np.sin(4 * X[:, 1]) + 0.1 * rng.normal(size=1536)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "monotone_constraints": [1, 0, 0],
+            "monotone_constraints_method": "advanced",
+            "min_data_in_leaf": 5, "deterministic": True}
+    serial = lgb.train(dict(base, tree_learner="serial"),
+                       lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    dist = lgb.train(dict(base, tree_learner="data"),
+                     lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    np.testing.assert_allclose(serial.predict(X), dist.predict(X),
+                               rtol=1e-5, atol=1e-6)
