@@ -95,3 +95,47 @@ def test_we_load_reference_trained_model(rng, tmp_path):
     ref_pred = _ref_predict(model, data, outp)
     ours = lgb.Booster(model_file=model).predict(X)
     np.testing.assert_allclose(ours, ref_pred, rtol=1e-6, atol=1e-9)
+
+
+def test_reference_loads_our_multiclass_model(rng, tmp_path):
+    X = rng.normal(size=(2400, 5)).round(4)
+    y = ((X[:, 0] > 0.4).astype(int) + (X[:, 1] > 0).astype(int)).astype(
+        float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 15, "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 8)
+    # _roundtrip handles the [n, 3] probability matrix unchanged
+    _roundtrip(bst, X, y, tmp_path, "mc")
+
+
+def test_reference_loads_our_rf_model(rng, tmp_path):
+    X = rng.normal(size=(2000, 5)).round(4)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(float)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "num_leaves": 15, "verbosity": -1,
+                     "bagging_freq": 1, "bagging_fraction": 0.7},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 8)
+    _roundtrip(bst, X, y, tmp_path, "rf")
+
+
+def test_reference_loads_our_dart_model(rng, tmp_path):
+    X = rng.normal(size=(2000, 5)).round(4)
+    y = (X[:, 0] - 0.4 * X[:, 2] ** 2 > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "boosting": "dart",
+                     "num_leaves": 15, "verbosity": -1,
+                     "drop_rate": 0.2},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 8)
+    _roundtrip(bst, X, y, tmp_path, "dart")
+
+
+def test_reference_loads_our_lambdarank_model(rng, tmp_path):
+    nq, per = 80, 20
+    n = nq * per
+    X = rng.normal(size=(n, 5)).round(4)
+    rel = np.clip((X[:, 0] + 0.3 * rng.normal(size=n) > 0.4).astype(int)
+                  + (X[:, 1] > 0.6).astype(int), 0, 3).astype(float)
+    bst = lgb.train({"objective": "lambdarank", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 10},
+                    lgb.Dataset(X, label=rel, group=np.full(nq, per),
+                                free_raw_data=False), 8)
+    _roundtrip(bst, X, rel, tmp_path, "lr")
