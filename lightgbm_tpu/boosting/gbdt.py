@@ -396,6 +396,16 @@ class GBDT:
             self._gain_scale = jnp.asarray(
                 fc[self.train_set.used_features])
 
+        # forced splits (forcedsplits_filename;
+        # SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:636):
+        # BFS over the JSON tree, thresholds mapped to bins, slots
+        # assigned under our numbering (round r: left keeps the slot,
+        # right becomes slot r+1)
+        self._forced_splits = None
+        if config.forcedsplits_filename:
+            self._forced_splits = self._parse_forced_splits(
+                config.forcedsplits_filename)
+
         # CEGB (cost_effective_gradient_boosting.hpp IsEnable)
         self._cegb = None
         self._cegb_feat_used = None
@@ -688,6 +698,9 @@ class GBDT:
             # sequential here anyway
             leaf_batch = 1
         kw["mono_method"] = mono_method
+        if self._forced_splits is not None:
+            kw["forced"] = self._forced_splits
+            leaf_batch = 1
         out = builder(
             self.train_dd.bins, gh, self.train_dd.row_leaf0,
             self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
@@ -706,6 +719,53 @@ class GBDT:
             self._cegb_feat_used, self._cegb_used_rows = cegb_state
             return tree_arrays, row_leaf, valid_rls
         return out
+
+    def _parse_forced_splits(self, path):
+        """JSON forced-split tree -> (parents, isright, feats, thrs)
+        static tuples in BFS order (ForceSplits queue semantics). Each
+        node records its parent's index in the list (-1 for the root)
+        and which side it forces — slots resolve at runtime inside the
+        builder so a dropped forced node drops its subtree. Feature
+        indices are ORIGINAL column ids; thresholds are raw values
+        mapped through the feature's BinMapper."""
+        import json as _json
+        from collections import deque
+        with open(path) as fh:
+            root = _json.load(fh)
+        if self.plan is not None and self.plan.parallel_mode != "data":
+            raise NotImplementedError(
+                "forced splits support the serial/data tree learners")
+        uf = list(self.train_set.used_features)
+        parents, isright, feats, thrs = [], [], [], []
+        q = deque([(root, -1, False)])
+        while q:
+            node, pj, is_r = q.popleft()
+            if not node:
+                continue
+            f_orig = int(node["feature"])
+            if f_orig not in uf:
+                raise ValueError(
+                    f"forced split feature {f_orig} is not a used "
+                    "feature of the dataset")
+            f_inner = uf.index(f_orig)
+            m = self.train_set.bin_mappers[f_orig]
+            if m.bin_type == "categorical":
+                raise NotImplementedError(
+                    "forced splits on categorical features are not "
+                    "supported")
+            thr_bin = int(m.values_to_bins(
+                np.asarray([float(node["threshold"])]))[0])
+            me = len(parents)
+            parents.append(pj)
+            isright.append(is_r)
+            feats.append(f_inner)
+            thrs.append(thr_bin)
+            if node.get("left"):
+                q.append((node["left"], me, False))
+            if node.get("right"):
+                q.append((node["right"], me, True))
+        return (tuple(parents), tuple(isright), tuple(feats),
+                tuple(thrs))
 
     def _quantize_impl(self, g, h, key):
         """Stochastic rounding onto the int8 quant grid

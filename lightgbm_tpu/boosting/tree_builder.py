@@ -63,7 +63,8 @@ import numpy as np
 
 from ..ops.histogram import build_histograms, HIST_CH
 from ..ops.predict import row_feature_gather
-from ..ops.split import SplitParams, find_best_splits, leaf_output
+from ..ops.split import (SplitParams, find_best_splits, leaf_gain,
+                         leaf_output)
 
 __all__ = ["TreeArrays", "build_tree", "max_rounds_for"]
 
@@ -108,7 +109,8 @@ def _round_int(x):
     static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
                      "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "feature_fraction_bynode",
-                     "parallel_mode", "top_k", "bundle_bins", "mono_method"))
+                     "parallel_mode", "top_k", "bundle_bins", "mono_method",
+                     "forced"))
 def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
@@ -133,7 +135,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                bundle_meta: Optional[Tuple] = None,
                bundle_bins: int = 0,
                quant_scales: Optional[jax.Array] = None,
-               mono_method: str = "basic"):
+               mono_method: str = "basic",
+               forced: Optional[Tuple] = None):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
@@ -243,6 +246,25 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             "monotone_constraints_method=intermediate/advanced requires "
             "leaf_batch=1 (sequential split application)")
     use_boxes = use_mono_inter or use_mono_adv
+    # forced splits (forcedsplits_filename; SerialTreeLearner::ForceSplits,
+    # serial_tree_learner.cpp:636): the first n_forced rounds apply the
+    # BFS-ordered forced list regardless of gain rank. Each entry is
+    # (parent_index_in_list | -1 for root, is_right_child, feature,
+    # threshold_bin). Slots resolve at RUNTIME from the parent's
+    # recorded apply (left child keeps the parent's slot; right child is
+    # the slot recorded when the parent actually applied), so a dropped
+    # forced node (negative net gain, starved side, depth limit) drops
+    # its whole subtree — the reference's forceSplitMap.erase semantics.
+    # leaf_batch must be 1.
+    use_forced = forced is not None and len(forced[0]) > 0
+    if use_forced and leaf_batch != 1:
+        raise ValueError("forced splits require leaf_batch=1")
+    if use_forced:
+        f_parent_a = jnp.asarray(forced[0], jnp.int32)
+        f_isright_a = jnp.asarray(forced[1], bool)
+        f_feats_a = jnp.asarray(forced[2], jnp.int32)
+        f_thrs_a = jnp.asarray(forced[3], jnp.int32)
+        n_forced = len(forced[0])
     use_inter = interaction_groups is not None
     use_bynode = feature_fraction_bynode < 1.0
     use_rand = bool(sp.extra_trees)
@@ -607,6 +629,12 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                  leaf_lo=jnp.full((L + 1,), -F32_MAX, f32),
                  leaf_hi=jnp.full((L + 1,), F32_MAX, f32),
                  r=jnp.asarray(0, jnp.int32))
+    if use_forced:
+        # per-forced-node runtime record: did it apply, at which slot,
+        # and which slot its right child received
+        state["f_ok"] = jnp.zeros((n_forced,), bool)
+        state["f_slot_rec"] = jnp.zeros((n_forced,), jnp.int32)
+        state["f_rslot"] = jnp.zeros((n_forced,), jnp.int32)
     if use_boxes:
         # inclusive bin-range box per leaf slot (feature space)
         state["box_lo"] = jnp.zeros((L + 1, F), jnp.int32)
@@ -660,6 +688,10 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         t = st["tree"]
         more_budget = t.num_leaves < L
         has_split = jnp.any(st["bs_gain"][:L] > NEG_INF)
+        if use_forced:
+            # forced rounds may proceed even when no cached candidate
+            # is splittable (their gain check happens in-body)
+            has_split = has_split | (st["r"] < n_forced)
         return (st["r"] < rounds_bound) & more_budget & has_split
 
     def body(st):
@@ -691,6 +723,103 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         # (SplitInfo::left_output/right_output analog)
         lval = jnp.take(st["bs_lout"], sel_s)
         rval = jnp.take(st["bs_rout"], sel_s)
+
+        new_state_forced = {}
+        if use_forced:
+            # ForceSplits rounds: override lane 0 with the forced
+            # candidate computed straight from the slot's histogram
+            # (GatherInfoForThreshold analog; missing routes right).
+            # A dropped forced candidate falls back to this round's
+            # normal top-gain pop and poisons its forced descendants.
+            fr = jnp.clip(st["r"], 0, n_forced - 1)
+            in_forced = st["r"] < n_forced
+            pj = jnp.take(f_parent_a, fr)
+            pjc = jnp.clip(pj, 0, n_forced - 1)
+            parent_ok = jnp.where(pj < 0, True, jnp.take(st["f_ok"], pjc))
+            f_slot = jnp.where(
+                pj < 0, 0,
+                jnp.where(jnp.take(f_isright_a, fr),
+                          jnp.take(st["f_rslot"], pjc),
+                          jnp.take(st["f_slot_rec"], pjc)))
+            f_feat = jnp.take(f_feats_a, fr)
+            f_thr = jnp.take(f_thrs_a, fr)
+            fslots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(f_slot)
+            hist_fc = jax.lax.cond(
+                in_forced,
+                lambda: hist_for(fslots, st["row_leaf"]),
+                lambda: jnp.zeros((2 * W, F, B, HIST_CH), jnp.float32))
+            hrow = jnp.take(hist_fc[0], f_feat, axis=0)       # [B, 3]
+            nb_f = jnp.take(nan_bin_pf, f_feat)
+            bval = (jnp.arange(B, dtype=jnp.int32)
+                    != jnp.where(nb_f >= 0, nb_f, -1))
+            cum = jnp.cumsum(jnp.where(bval[:, None], hrow, 0.0), axis=0)
+            tot = hrow.sum(axis=0)
+            lsum = jnp.take(cum, jnp.clip(f_thr, 0, B - 1), axis=0)
+            rsum = tot - lsum
+            l1_, l2_ = sp.lambda_l1, sp.lambda_l2
+            node_of_f = jnp.take(t.leaf2node,
+                                 jnp.clip(f_slot, 0, L))
+            po_f = jnp.take(t.node_value, node_of_f)
+            sm_f = ({} if sp.path_smooth <= 0.0
+                    else dict(path_smooth=sp.path_smooth,
+                              parent_output=po_f))
+            from ..ops.split import calc_output as _calc_out
+            f_lout = _calc_out(lsum[0], lsum[1], l1_, l2_,
+                               sp.max_delta_step,
+                               count=lsum[2] if sm_f else None, **sm_f)
+            f_rout = _calc_out(rsum[0], rsum[1], l1_, l2_,
+                               sp.max_delta_step,
+                               count=rsum[2] if sm_f else None, **sm_f)
+            # NET gain: split - parent - min_gain_to_split, the same
+            # shift GatherInfoForThreshold applies before the erase test
+            f_gain = (leaf_gain(lsum[0], lsum[1], l1_, l2_)
+                      + leaf_gain(rsum[0], rsum[1], l1_, l2_)
+                      - leaf_gain(tot[0], tot[1], l1_, l2_)
+                      - sp.min_gain_to_split)
+            depth_f = jnp.take(st["leaf_depth"], jnp.clip(f_slot, 0, L))
+            ok_f = (in_forced & parent_ok
+                    & (lsum[2] >= sp.min_data_in_leaf)
+                    & (rsum[2] >= sp.min_data_in_leaf)
+                    & (lsum[1] >= sp.min_sum_hessian_in_leaf)
+                    & (rsum[1] >= sp.min_sum_hessian_in_leaf)
+                    & (f_gain >= 0)
+                    & ((max_depth <= 0) | (depth_f < max_depth))
+                    & (jnp.take(t.leaf2node, f_slot) != DUMMY_NODE))
+            new_state_forced = dict(
+                f_ok=st["f_ok"].at[fr].set(
+                    jnp.where(in_forced, ok_f, st["f_ok"][fr])),
+                f_slot_rec=st["f_slot_rec"].at[fr].set(
+                    jnp.where(in_forced, f_slot, st["f_slot_rec"][fr])),
+                # with W=1 an applied split's right child gets slot `cur`
+                f_rslot=st["f_rslot"].at[fr].set(
+                    jnp.where(in_forced, cur, st["f_rslot"][fr])))
+
+            def _ov(arr, new):
+                return arr.at[0].set(jnp.where(ok_f, new, arr[0]))
+            # re-derive the lane-0 selection chain under the override
+            sel_s = _ov(sel_s, f_slot)
+            valid = valid.at[0].set(ok_f | valid[0])
+            n_valid = valid.sum().astype(jnp.int32)
+            pos = jnp.cumsum(valid.astype(jnp.int32)) - 1
+            sel_s = jnp.where(valid, sel_s, DUMMY_LEAF)
+            right_slot = jnp.where(valid, cur + pos, DUMMY_LEAF)
+            ln = jnp.where(valid, nodes + 2 * pos, DUMMY_NODE)
+            rn = jnp.where(valid, nodes + 2 * pos + 1, DUMMY_NODE)
+            parent = jnp.where(valid, jnp.take(t.leaf2node, sel_s),
+                               DUMMY_NODE)
+            sfeat = _ov(sfeat, f_feat)
+            sthr = _ov(sthr, f_thr)
+            sdl = _ov(sdl, False)
+            scat = _ov(scat, False)
+            sgain = _ov(sgain, f_gain)
+            slsum = slsum.at[0].set(jnp.where(ok_f, lsum, slsum[0]))
+            srsum = srsum.at[0].set(jnp.where(ok_f, rsum, srsum[0]))
+            sbits = sbits.at[0].set(jnp.where(ok_f,
+                                              jnp.zeros((BW,), jnp.uint32),
+                                              sbits[0]))
+            lval = _ov(lval, f_lout)
+            rval = _ov(rval, f_rout)
+
         if use_mono_inter:
             # stale-cache guard: neighbor propagation may have tightened
             # this leaf's bounds after its split was cached; clamp into
@@ -933,7 +1062,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                    bs_right=bs_right, bs_bits=bs_bits, bs_lout=bs_lout,
                    bs_rout=bs_rout,
                    leaf_depth=leaf_depth, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
-                   r=st["r"] + 1, **new_state_extra, **new_state_mono)
+                   r=st["r"] + 1, **new_state_extra, **new_state_mono,
+                   **new_state_forced)
         return out
 
     state = jax.lax.while_loop(cond, body, state)

@@ -202,6 +202,9 @@ PARAMS: Dict[str, ParamSpec] = {
                                                     "categorical_column",
                                                     "cat_column")),
         _p("forcedbins_filename", "", str),
+        _p("forcedsplits_filename", "", str,
+           aliases=("fs", "forced_splits_filename", "forced_splits_file",
+                    "forced_splits")),
         _p("save_binary", False, bool, aliases=("is_save_binary",
                                                 "is_save_binary_file")),
         _p("precise_float_parser", False, bool),

@@ -155,7 +155,7 @@ class DataParallelPlan:
                    rng_key=None, feature_fraction_bynode: float = 1.0,
                    bundle_meta=None, bundle_bins: int = 0,
                    quant_scales=None, mono_method: str = "basic",
-                   cat_sorted_mask=None):
+                   cat_sorted_mask=None, forced=None):
         return build_tree_dp(
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask, num_leaves=num_leaves,
@@ -170,7 +170,7 @@ class DataParallelPlan:
             parallel_mode=self.parallel_mode, top_k=self.top_k,
             bundle_meta=bundle_meta, bundle_bins=bundle_bins,
             quant_scales=quant_scales, mono_method=mono_method,
-            cat_sorted_mask=cat_sorted_mask)
+            cat_sorted_mask=cat_sorted_mask, forced=forced)
 
 
 class VotingParallelPlan(DataParallelPlan):
@@ -342,14 +342,14 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                      "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "n_valid", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins",
-                     "mono_method"))
+                     "mono_method", "forced"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl, block_rows,
                        n_valid, feature_fraction_bynode,
                        parallel_mode="data", top_k=20, bundle_bins=0,
-                       mono_method="basic"):
+                       mono_method="basic", forced=None):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
@@ -371,7 +371,7 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             parallel_mode=parallel_mode, top_k=top_k,
             bundle_meta=bmeta, bundle_bins=bundle_bins,
             quant_scales=qs, mono_method=mono_method,
-            cat_sorted_mask=csm)
+            cat_sorted_mask=csm, forced=forced)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
@@ -403,7 +403,7 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   parallel_mode: str = "data", top_k: int = 20,
                   bundle_meta=None, bundle_bins: int = 0,
                   quant_scales=None, mono_method: str = "basic",
-                  cat_sorted_mask=None):
+                  cat_sorted_mask=None, forced=None):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
@@ -423,4 +423,4 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
         n_valid=len(valid_bins),
         feature_fraction_bynode=feature_fraction_bynode,
         parallel_mode=parallel_mode, top_k=top_k,
-        bundle_bins=bundle_bins, mono_method=mono_method)
+        bundle_bins=bundle_bins, mono_method=mono_method, forced=forced)

@@ -1,0 +1,166 @@
+"""forcedsplits_filename (SerialTreeLearner::ForceSplits,
+serial_tree_learner.cpp:636): BFS-forced tree prefixes applied
+regardless of gain rank; dropped when the candidate's gain is negative
+or a side is starved (forceSplitMap.erase semantics)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _forced_file(tmp_path, spec):
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps(spec))
+    return str(p)
+
+
+def _data(rng, n=2000):
+    X = rng.normal(size=(n, 5))
+    y = X[:, 0] + 0.5 * X[:, 2] ** 2 + 0.1 * rng.normal(size=n)
+    return X, y
+
+
+def test_forced_structure_applied(rng, tmp_path):
+    X, y = _data(rng)
+    f = _forced_file(tmp_path, {
+        "feature": 2, "threshold": 0.0,
+        "left": {"feature": 0, "threshold": -0.5},
+        "right": {"feature": 0, "threshold": 0.5}})
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "forcedsplits_filename": f},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 4)
+    for t in bst._all_trees():
+        assert t.split_feature[0] == 2
+        for child in (t.left_child[0], t.right_child[0]):
+            if child >= 0:
+                assert t.split_feature[child] == 0
+    # training still learns beyond the forced prefix
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.4
+
+
+def test_forced_threshold_maps_to_bin_boundary(rng, tmp_path):
+    X, y = _data(rng)
+    f = _forced_file(tmp_path, {"feature": 1, "threshold": 0.25})
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "forcedsplits_filename": f},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 2)
+    t = bst._all_trees()[0]
+    assert t.split_feature[0] == 1
+    # stored real threshold straddles the requested value's bin
+    assert abs(t.threshold[0] - 0.25) < 0.2
+
+
+def test_forced_split_dropped_when_starved(rng, tmp_path):
+    """A forced threshold putting (almost) everything on one side fails
+    min_data_in_leaf and must fall back to normal selection."""
+    X, y = _data(rng)
+    f = _forced_file(tmp_path, {"feature": 3, "threshold": 1e9})
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 20,
+                     "forcedsplits_filename": f},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 2)
+    t = bst._all_trees()[0]
+    assert t.num_leaves > 1          # tree still grew
+    # and the root is NOT the degenerate forced split
+    assert not (t.split_feature[0] == 3 and t.threshold[0] > 1e8)
+
+
+def test_forced_matches_reference_structure(rng, tmp_path):
+    ref_bin = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".ref_build", "lightgbm")
+    if not os.path.exists(ref_bin):
+        pytest.skip("reference binary not built")
+    import subprocess
+    X, y = _data(rng)
+    f = _forced_file(tmp_path, {
+        "feature": 2, "threshold": 0.0,
+        "left": {"feature": 0, "threshold": -0.5}})
+    data = str(tmp_path / "fs.train")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.9g")
+    model = str(tmp_path / "fs_ref.txt")
+    subprocess.run(
+        [ref_bin, "task=train", f"data={data}", "objective=regression",
+         "num_leaves=15", "num_iterations=3", "min_data_in_leaf=5",
+         f"forcedsplits_filename={f}", f"output_model={model}",
+         "verbosity=-1"], check=True, capture_output=True, timeout=120)
+    ref = lgb.Booster(model_file=model)
+    ours = lgb.train({"objective": "regression", "num_leaves": 15,
+                      "verbosity": -1, "min_data_in_leaf": 5,
+                      "forcedsplits_filename": f},
+                     lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    for rt, ot in zip(ref._all_trees(), ours._all_trees()):
+        assert rt.split_feature[0] == ot.split_feature[0] == 2
+        assert ot.split_feature[rt.left_child[0]] == 0
+
+
+def test_forced_error_paths(rng, tmp_path):
+    X, y = _data(rng, n=400)
+    f = _forced_file(tmp_path, {"feature": 99, "threshold": 0.0})
+    with pytest.raises(ValueError, match="used feature"):
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "forcedsplits_filename": f},
+                  lgb.Dataset(X, label=y, free_raw_data=False), 1)
+
+
+def test_forced_splits_data_parallel(rng, tmp_path):
+    X, y = _data(rng, n=1536)
+    f = _forced_file(tmp_path, {"feature": 2, "threshold": 0.0})
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "forcedsplits_filename": f,
+            "deterministic": True}
+    serial = lgb.train(dict(base, tree_learner="serial"),
+                       lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    dist = lgb.train(dict(base, tree_learner="data"),
+                     lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    np.testing.assert_allclose(serial.predict(X), dist.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    assert dist._all_trees()[0].split_feature[0] == 2
+
+
+def test_dropped_forced_root_drops_subtree(rng, tmp_path):
+    """forceSplitMap.erase semantics: when the forced root is dropped
+    (starved side), its forced child must NOT fire against whatever
+    normal split took that round."""
+    X, y = _data(rng)
+    f = _forced_file(tmp_path, {
+        "feature": 3, "threshold": 1e9,          # starved -> dropped
+        "left": {"feature": 4, "threshold": 0.0}})
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 20,
+                     "forcedsplits_filename": f},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 2)
+    # leaf_batch=1 to match the forced build's sequential popping
+    free = lgb.train({"objective": "regression", "num_leaves": 15,
+                      "verbosity": -1, "min_data_in_leaf": 20,
+                      "leaf_batch": 1},
+                     lgb.Dataset(X, label=y, free_raw_data=False), 2)
+    # with the whole forced subtree dropped, training must match the
+    # unforced run exactly
+    np.testing.assert_allclose(bst.predict(X), free.predict(X))
+
+
+def test_forced_respects_max_depth(rng, tmp_path):
+    X, y = _data(rng)
+    f = _forced_file(tmp_path, {
+        "feature": 2, "threshold": 0.0,
+        "left": {"feature": 0, "threshold": 0.0,
+                 "left": {"feature": 1, "threshold": 0.0}}})
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "max_depth": 2, "forcedsplits_filename": f},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 2)
+    for t in bst._all_trees():
+        # walk depths: no leaf deeper than 2
+        depth = {0: 1}
+        for n in range(t.num_leaves - 1):
+            for c in (t.left_child[n], t.right_child[n]):
+                if c >= 0:
+                    depth[c] = depth[n] + 1
+                    assert depth[c] <= 2
