@@ -120,7 +120,10 @@ def run(params: Dict[str, str]) -> int:
             pred_leaf=bool(cfg.predict_leaf_index),
             pred_contrib=bool(cfg.predict_contrib),
             start_iteration=int(cfg.start_iteration_predict),
-            num_iteration=None if n_iter <= 0 else n_iter)
+            num_iteration=None if n_iter <= 0 else n_iter,
+            pred_early_stop=bool(cfg.pred_early_stop),
+            pred_early_stop_freq=int(cfg.pred_early_stop_freq),
+            pred_early_stop_margin=float(cfg.pred_early_stop_margin))
         out = np.asarray(pred)
         with open(cfg.output_result, "w") as f:
             if out.ndim == 1:
