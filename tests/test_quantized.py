@@ -112,3 +112,26 @@ def test_quantized_renew_leaf_changes_outputs(rng):
     # ...without degrading quality (trajectories diverge after round 1,
     # so only near-parity is guaranteed, not strict improvement)
     assert np.mean((b - y) ** 2) <= np.mean((a - y) ** 2) * 1.05
+
+
+def test_quantized_composes_with_efb(rng):
+    """int8 histograms in BUNDLE space: the integer histogram is
+    dequantized before the FixHistogram unbundling, so EFB + quantized
+    training must track the full-precision EFB run closely."""
+    n, F = 2048, 12
+    X = np.zeros((n, F))
+    perm = rng.permutation(n)
+    for f in range(F):  # strictly exclusive features -> bundles form
+        rows = perm[f * (n // F):(f + 1) * (n // F)]
+        X[rows, f] = rng.normal(size=len(rows)) + 1.0
+    y = (X[:, 0] - X[:, 1] + 0.3 * X[:, 2] > 0.2).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "enable_bundle": True}
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    assert ds.construct().bundle_plan is not None
+    full = lgb.train(base, ds, 10)
+    quant = lgb.train(dict(base, use_quantized_grad=True),
+                      lgb.Dataset(X, label=y, free_raw_data=False), 10)
+    a_f = roc_auc_score(y, full.predict(X))
+    a_q = roc_auc_score(y, quant.predict(X))
+    assert a_q > a_f - 0.02, (a_q, a_f)
