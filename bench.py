@@ -284,6 +284,7 @@ def main():
             dq = time.time() - tq
             quant_fields = {
                 "quant_row_trees_per_s": round(n_rows * q_iters / dq, 1),
+                "quant_iters": q_iters,   # AUC below is at THIS count
                 "quant_train_auc": round(float(
                     bq.eval_train()[0][2]), 6),
             }
