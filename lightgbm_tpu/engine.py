@@ -938,8 +938,8 @@ def cv(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             and not any(getattr(c, "order", 0) == 30 for c in cbs):
         from .callback import early_stopping as _es
         cbs.append(_es(cfg_cv.early_stopping_round,
-                       first_metric_only=bool(
-                           cfg_cv.first_metric_only)))
+                       first_metric_only=bool(cfg_cv.first_metric_only),
+                       min_delta=cfg_cv.early_stopping_min_delta))
     cbs = sorted(cbs, key=lambda c: getattr(c, "order", 0))
     cbs_before = [c for c in cbs if getattr(c, "before_iteration", False)]
     cbs_after = [c for c in cbs if not getattr(c, "before_iteration",
