@@ -681,6 +681,74 @@ class Booster:
     def num_feature(self) -> int:
         return self._max_feature_idx + 1
 
+    def num_model_per_iteration(self) -> int:
+        """LGBM_BoosterNumModelPerIteration analog."""
+        return max(1, self._num_class)
+
+    def lower_bound(self) -> float:
+        """Minimum possible raw output: sum of per-tree min leaf values
+        (LGBM_BoosterGetLowerBoundValue)."""
+        return float(sum(t.leaf_value.min() for t in self._all_trees()
+                         if t.num_leaves > 0))
+
+    def upper_bound(self) -> float:
+        """Maximum possible raw output (LGBM_BoosterGetUpperBoundValue)."""
+        return float(sum(t.leaf_value.max() for t in self._all_trees()
+                         if t.num_leaves > 0))
+
+    def trees_to_dataframe(self):
+        """Model structure as a pandas DataFrame — same columns and node
+        naming as the reference ``Booster.trees_to_dataframe``, built on
+        top of ``dump_model()`` exactly like the reference (basic.py):
+        one decoder, so categorical thresholds ("0||2||5") and
+        missing_type strings match the JSON dump by construction."""
+        import pandas as pd
+        dump = self.dump_model()
+        feat_names = dump["feature_names"]
+        rows = []
+        for tinfo in dump["tree_info"]:
+            ti = tinfo["tree_index"]
+            stack = [(tinfo["tree_structure"], 1, None)]
+            while stack:
+                node, depth_, parent_name = stack.pop()
+                if "split_index" in node:
+                    my = f"{ti}-S{node['split_index']}"
+
+                    def cname(c):
+                        return (f"{ti}-S{c['split_index']}"
+                                if "split_index" in c
+                                else f"{ti}-L{c.get('leaf_index', 0)}")
+                    rows.append(dict(
+                        tree_index=ti, node_depth=depth_, node_index=my,
+                        left_child=cname(node["left_child"]),
+                        right_child=cname(node["right_child"]),
+                        parent_index=parent_name,
+                        split_feature=feat_names[node["split_feature"]],
+                        split_gain=node["split_gain"],
+                        threshold=node["threshold"],
+                        decision_type=node["decision_type"],
+                        missing_direction=("left" if node["default_left"]
+                                           else "right"),
+                        missing_type=node["missing_type"],
+                        value=node["internal_value"],
+                        weight=node["internal_weight"],
+                        count=node["internal_count"]))
+                    stack.append((node["right_child"], depth_ + 1, my))
+                    stack.append((node["left_child"], depth_ + 1, my))
+                else:
+                    rows.append(dict(
+                        tree_index=ti, node_depth=depth_,
+                        node_index=f"{ti}-L{node.get('leaf_index', 0)}",
+                        left_child=None, right_child=None,
+                        parent_index=parent_name, split_feature=None,
+                        split_gain=None, threshold=None,
+                        decision_type=None, missing_direction=None,
+                        missing_type=None,
+                        value=node["leaf_value"],
+                        weight=node.get("leaf_weight"),
+                        count=node.get("leaf_count")))
+        return pd.DataFrame(rows)
+
     def feature_name(self) -> List[str]:
         return list(self._feature_names)
 

@@ -121,3 +121,59 @@ def test_profiler_annotations_smoke(booster, rng, tmp_path):
         pass
     with prof.step_annotation("step", step_num=3):
         pass
+
+
+def test_trees_to_dataframe_and_bounds(rng):
+    """Booster.trees_to_dataframe / lower_bound / upper_bound /
+    num_model_per_iteration (basic.py Booster surface)."""
+    pd = pytest.importorskip("pandas")
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(800, 4))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    df = bst.trees_to_dataframe()
+    assert set(df.columns) == {
+        "tree_index", "node_depth", "node_index", "left_child",
+        "right_child", "parent_index", "split_feature", "split_gain",
+        "threshold", "decision_type", "missing_direction",
+        "missing_type", "value", "weight", "count"}
+    t0 = df[df.tree_index == 0]
+    n_leaves = bst._all_trees()[0].num_leaves
+    assert len(t0) == 2 * n_leaves - 1
+    root = t0[t0.node_index == "0-S0"].iloc[0]
+    assert pd.isna(root.parent_index) and root.node_depth == 1
+    # every child named by an internal node exists
+    names = set(t0.node_index)
+    for _, r in t0.iterrows():
+        if pd.notna(r.left_child):
+            assert r.left_child in names and r.right_child in names
+    # leaf counts per tree sum to the dataset size
+    assert t0[t0.node_index.str.contains("-L")]["count"].sum() == 800
+    # bounds bracket every prediction
+    raw = bst.predict(X, raw_score=True)
+    assert bst.lower_bound() <= raw.min() + 1e-9
+    assert bst.upper_bound() >= raw.max() - 1e-9
+    assert bst.num_model_per_iteration() == 1
+
+
+def test_trees_to_dataframe_categorical_threshold(rng):
+    """Categorical splits must show the category set ("0||2||..."), not
+    the internal cat-storage index (same decoding as dump_model)."""
+    pd = pytest.importorskip("pandas")
+    import lightgbm_tpu as lgb
+    c = rng.randint(0, 12, size=1200)
+    means = rng.normal(size=12) * 2
+    X = np.column_stack([c.astype(float), rng.normal(size=1200)])
+    y = means[c] + 0.1 * rng.normal(size=1200)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_per_group": 5,
+                     "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0],
+                                free_raw_data=False), 3)
+    df = bst.trees_to_dataframe()
+    cat_rows = df[df.decision_type == "=="]
+    assert len(cat_rows) > 0
+    assert all("||" in str(t) or str(t).isdigit()
+               for t in cat_rows.threshold)
