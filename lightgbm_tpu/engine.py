@@ -685,6 +685,41 @@ class Booster:
         """LGBM_BoosterNumModelPerIteration analog."""
         return max(1, self._num_class)
 
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """LGBM_BoosterGetLeafValue analog (shrinkage included)."""
+        return float(self._all_trees()[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """LGBM_BoosterSetLeafValue analog: overwrite one leaf's output
+        (model-surgery tools use this; prediction caches invalidate)."""
+        self._all_trees()[tree_id].leaf_value[leaf_id] = float(value)
+        self._model_version += 1
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute tree ITERATIONS in [start, end) —
+        basic.py Booster.shuffle_models (LGBM_BoosterShuffleModels).
+        Multiclass iterations move as whole per-class groups."""
+        K = max(1, self._num_class)
+        trees = self._all_trees()
+        n_iter = len(trees) // K
+        lo = max(0, start_iteration)
+        hi = n_iter if end_iteration < 0 else min(end_iteration, n_iter)
+        if hi - lo > 1:
+            order = np.arange(lo, hi)
+            np.random.shuffle(order)
+            groups = [trees[i * K:(i + 1) * K] for i in range(n_iter)]
+            shuffled = (groups[:lo] + [groups[i] for i in order]
+                        + groups[hi:])
+            flat = [t for g in shuffled for t in g]
+            nb = len(self._base_trees)
+            self._base_trees = flat[:nb]
+            self._trees[:] = flat[nb:]
+            self._model_version += 1
+        return self
+
     def lower_bound(self) -> float:
         """Minimum possible raw output: sum of per-tree min leaf values
         (LGBM_BoosterGetLowerBoundValue)."""
