@@ -175,16 +175,29 @@ def probe_hist_impl(platform: str) -> dict:
                 bench_one(out["hist_impl"], lids2) * 1e3, 2)
         except Exception:
             pass
+    # roofline context for the chosen kernel on EVERY platform (reuse
+    # the timing already measured above when one exists)
+    try:
+        prior_ms = out.get("hist_pallas_ms"
+                           if out["hist_impl"] == "pallas"
+                           else "hist_matmul_ms")
+        t_chosen = (prior_ms / 1e3 if prior_ms
+                    else bench_one(out["hist_impl"]))
+        out["hist_ms"] = round(t_chosen * 1e3, 2)
+        out.update(kernel_roofline_fields(platform, t_chosen, R, F, B, L))
+    except Exception as e:
+        print(f"roofline probe failed: {e}", file=sys.stderr)
     return out
 
 
-def ref_same_host_probe(X, y, iters, max_bin) -> dict:
-    """When the CPU fallback is what we're measuring, also time the
-    ACTUAL reference binary (if built — tests/golden/README.md) on the
-    same rows and host, single-threaded: the published 40.36M
-    row-trees/s baseline used 16 threads on a 28-core Xeon, so the
-    same-host single-core ratio is the honest CPU comparison. Bounded:
-    rows capped at 2^18 and the run at 120s."""
+def ref_same_host_probe(X, y, Xv, yv, iters, max_bin) -> dict:
+    """Time the ACTUAL reference binary (if built —
+    tests/golden/README.md) on the same rows/host, single-threaded, on
+    EVERY platform (VERDICT r3 #5): the published 40.36M row-trees/s
+    baseline used 16 threads on a 28-core Xeon, so the same-host
+    single-core ratio is the honest CPU comparison, and a TPU number
+    lands next to a same-data reference AUC/throughput anchor. Bounded:
+    rows capped at 2^20 and the run at 300s."""
     import subprocess
     ref_bin = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            ".ref_build", "lightgbm")
@@ -194,33 +207,111 @@ def ref_same_host_probe(X, y, iters, max_bin) -> dict:
     import tempfile
     tmpdir = tempfile.mkdtemp(prefix="bench_ref_")
     try:
-        n = min(len(y), 1 << 18)
+        n = min(len(y), 1 << 20)
+        ref_iters = min(iters, 40)
         csv = os.path.join(tmpdir, "probe.csv")
         np.savetxt(csv, np.column_stack([y[:n], X[:n]]), delimiter=",",
                    fmt="%.6g")
+        vcsv = os.path.join(tmpdir, "valid.csv")
+        np.savetxt(vcsv, np.column_stack([yv, Xv]), delimiter=",",
+                   fmt="%.6g")
         out = subprocess.run(
-            [ref_bin, "task=train", f"data={csv}", "objective=binary",
+            [ref_bin, "task=train", f"data={csv}", f"valid={vcsv}",
+             "objective=binary", "metric=auc",
              "num_leaves=255", f"max_bin={max_bin}",
-             f"num_iterations={iters}", "learning_rate=0.1",
+             f"num_iterations={ref_iters}", "learning_rate=0.1",
              "min_data_in_leaf=100", "num_threads=1", "verbosity=1",
+             "metric_freq=" + str(ref_iters),
              "output_model=" + os.path.join(tmpdir, "model.txt")],
-            capture_output=True, text=True, timeout=120)
+            capture_output=True, text=True, timeout=300)
         train_s = None
+        ref_auc = None
         for ln in out.stdout.splitlines():
             if "seconds elapsed, finished iteration" in ln:
                 train_s = float(ln.split("]")[-1].strip().split(" ")[0])
+            if "auc :" in ln:
+                ref_auc = float(ln.rsplit(":", 1)[1].strip())
         if out.returncode != 0 or train_s is None:
             print("same-host reference probe: reference run failed "
                   f"(rc={out.returncode})", file=sys.stderr)
             return {}
-        return {"ref_same_host_row_trees_per_s":
-                round(n * iters / train_s, 1),
-                "ref_same_host_rows": n}
+        fields = {"ref_same_host_row_trees_per_s":
+                  round(n * ref_iters / train_s, 1),
+                  "ref_same_host_rows": n,
+                  "ref_same_host_iters": ref_iters}
+        if ref_auc is not None:
+            fields["ref_same_host_valid_auc"] = round(ref_auc, 6)
+        return fields
     except Exception as e:
         print(f"same-host reference probe failed: {e}", file=sys.stderr)
         return {}
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# bf16 matmul TFLOP/s and HBM GB/s peaks per chip generation (public
+# spec-sheet numbers; used only to contextualize the kernel timing)
+TPU_PEAKS = {"v4": (275.0, 1228.0), "v5e": (197.0, 819.0),
+             "v5p": (459.0, 2765.0), "v6": (918.0, 1640.0)}
+
+
+def kernel_roofline_fields(platform: str, t_hist_s: float,
+                           R: int, F: int, B: int, L: int) -> dict:
+    """Derived FLOP/s + HBM bandwidth for one histogram build vs chip
+    peak (VERDICT r3 #1c — the numbers the >=5x-CUDA target is judged
+    on). FLOPs count the one-hot matmul as executed on the MXU
+    (2*R*(F*B)*(L*3)); bytes count the irreducible Pallas streams
+    (bins uint8 + gh f32 in, hist f32 out). On CPU the same fields are
+    emitted, labelled by `platform`, peak comparison omitted."""
+    flops = 2.0 * R * (F * B) * (L * HIST_CH_BENCH)
+    bytes_ = R * F + R * HIST_CH_BENCH * 4 + F * B * L * HIST_CH_BENCH * 4
+    out = {"hist_tflops": round(flops / t_hist_s / 1e12, 3),
+           "hist_hbm_gbps": round(bytes_ / t_hist_s / 1e9, 2)}
+    if platform == "tpu":
+        try:
+            import jax
+            kind = jax.devices()[0].device_kind.lower()
+            for k, (pf, pb) in TPU_PEAKS.items():
+                if k in kind:
+                    out["hist_mfu"] = round(out["hist_tflops"] / pf, 4)
+                    out["hist_hbm_util"] = round(
+                        out["hist_hbm_gbps"] / pb, 4)
+                    out["chip"] = kind
+                    break
+        except Exception:
+            pass
+    return out
+
+
+HIST_CH_BENCH = 3
+
+
+def hist_stream_fields(bst, n_rows: int, num_leaves: int,
+                       leaf_batch: int) -> dict:
+    """Rows streamed through the bin matrix per tree, measured from the
+    built trees' node counts (VERDICT r3 #2 'done' evidence): with
+    histogram subtraction each round streams only the smaller children's
+    rows (root pass + sum of min-child counts); without it every round
+    streams all R rows."""
+    from lightgbm_tpu.boosting.tree_builder import max_rounds_for
+    trees = bst._gbdt.models[-min(3, len(bst._gbdt.models)):]
+    subs = []
+    for tr in trees:
+        lc, rc = tr.left_child, tr.right_child
+        ic, lcnt = tr.internal_count, tr.leaf_count
+
+        def cnt(child):
+            return ic[child] if child >= 0 else lcnt[~child]
+        small = sum(min(cnt(lc[i]), cnt(rc[i])) for i in range(len(lc)))
+        subs.append(n_rows + small)
+    rows_sub = float(np.mean(subs))
+    rounds = max_rounds_for(num_leaves, max(1, min(leaf_batch,
+                                                   num_leaves - 1)))
+    rows_direct = float((1 + rounds) * n_rows)
+    return {"hist_rows_per_tree": round(rows_sub, 0),
+            "hist_rows_per_tree_direct": round(rows_direct, 0),
+            "hist_stream_reduction": round(1.0 - rows_sub / rows_direct,
+                                           4)}
 
 
 def main():
@@ -239,7 +330,15 @@ def main():
     hist_fields = probe_hist_impl(platform)
     print(f"histogram kernel: {hist_fields}", file=sys.stderr)
 
-    X, y = make_higgs_like(n_rows)
+    # 10% held-out split (VERDICT r3 #5) carved from the SAME generated
+    # pool (the labeling concept is seed-dependent, so a fresh seed
+    # would be a different task, not a test fold) — the synthetic
+    # analog of the Higgs test fold (docs/Experiments.rst:134)
+    n_valid = max(1 << 14, min(n_rows // 10, 1 << 20))
+    X_all, y_all = make_higgs_like(n_rows + n_valid)
+    X, y = X_all[:n_rows], y_all[:n_rows]
+    Xv, yv = X_all[n_rows:], y_all[n_rows:]
+    del X_all, y_all
     params = dict(objective="binary", metric="auc", num_leaves=255,
                   learning_rate=0.1, max_bin=max_bin, leaf_batch=21,
                   min_data_in_leaf=100, verbosity=-1,
@@ -249,9 +348,11 @@ def main():
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     ds.construct()
+    dsv = lgb.Dataset(Xv, label=yv, reference=ds).construct()
     t_bin = time.time() - t0
     t0 = time.time()
-    bst = lgb.train(params, ds, num_boost_round=warmup)
+    bst = lgb.train(params, ds, num_boost_round=warmup,
+                    valid_sets=[dsv], valid_names=["held-out"])
     t_compile = time.time() - t0
     print(f"binning {t_bin:.1f}s; compile+{warmup} warmup iters "
           f"{t_compile:.1f}s", file=sys.stderr)
@@ -265,36 +366,49 @@ def main():
 
     throughput = n_rows * iters / dt
     auc = bst.eval_train()[0][2]
+    valid_auc = bst.eval_valid()[0][2]
     print(f"{iters} iters in {dt:.2f}s = {dt / iters * 1e3:.0f} ms/tree, "
-          f"train AUC {auc:.4f}", file=sys.stderr)
+          f"train AUC {auc:.4f}, valid AUC {valid_auc:.4f}",
+          file=sys.stderr)
 
-    # quantized end-to-end ablation (int8 histograms; BENCH_QUANT=0 skips)
+    stream_fields = {}
+    try:
+        stream_fields = hist_stream_fields(bst, n_rows, 255, 21)
+    except Exception as e:
+        print(f"hist stream accounting failed: {e}", file=sys.stderr)
+
+    # quantized end-to-end ablation at the SAME iteration count as the
+    # full run (VERDICT r3 #4 — equal trees or the AUC delta is
+    # meaningless; BENCH_QUANT=0 skips)
     quant_fields = {}
     if os.environ.get("BENCH_QUANT", "1") != "0":
         try:
-            q_iters = max(5, iters // 4)
+            q_iters = warmup + iters
             # reuse the constructed dataset: identical binning params,
             # and a second 10.5M-row binning pass is pure waste
             bq = lgb.train(dict(params, use_quantized_grad=True),
-                           ds, num_boost_round=2)
+                           ds, num_boost_round=warmup,
+                           valid_sets=[dsv], valid_names=["held-out"])
             tq = time.time()
-            for _ in range(q_iters):
+            for _ in range(iters):
                 bq.update()
             bq._gbdt.scores.block_until_ready()
             dq = time.time() - tq
+            q_auc = float(bq.eval_train()[0][2])
             quant_fields = {
-                "quant_row_trees_per_s": round(n_rows * q_iters / dq, 1),
-                "quant_iters": q_iters,   # AUC below is at THIS count
-                "quant_train_auc": round(float(
-                    bq.eval_train()[0][2]), 6),
+                "quant_row_trees_per_s": round(n_rows * iters / dq, 1),
+                "quant_iters": q_iters,   # == warmup + iters of full run
+                "quant_train_auc": round(q_auc, 6),
+                "quant_auc_delta": round(float(auc) - q_auc, 6),
+                "quant_valid_auc": round(float(
+                    bq.eval_valid()[0][2]), 6),
             }
-            print(f"quantized: {q_iters} iters in {dq:.2f}s",
+            print(f"quantized: {iters} iters in {dq:.2f}s",
                   file=sys.stderr)
         except Exception as e:
             print(f"quant train ablation failed: {e}", file=sys.stderr)
 
-    ref_fields = (ref_same_host_probe(X, y, iters, max_bin)
-                  if platform == "cpu" else {})
+    ref_fields = ref_same_host_probe(X, y, Xv, yv, iters, max_bin)
 
     print(json.dumps({
         "metric": "higgs_binary_train_throughput",
@@ -303,11 +417,14 @@ def main():
         "vs_baseline": round(throughput / BASELINE_ROW_TREES_PER_S, 4),
         "platform": platform,
         "train_auc": round(float(auc), 6),
+        "valid_auc": round(float(valid_auc), 6),
+        "valid_rows": n_valid,
         "rows": n_rows, "iters": iters, "max_bin": max_bin,
         "binning_s": round(t_bin, 2),
         "compile_warmup_s": round(t_compile, 2),
         "train_s": round(dt, 2),
         "ms_per_tree": round(dt / iters * 1e3, 1),
+        **stream_fields,
         **quant_fields,
         **ref_fields,
         **hist_fields,

@@ -33,7 +33,7 @@ import numpy as np
 from ..config import Config
 from ..dataset import Dataset
 from ..objectives import Objective
-from ..ops.histogram import block_rows_for
+from ..ops.histogram import block_rows_for, resolve_impl
 from ..ops.split import SplitParams
 from ..tree import Tree
 from .tree_builder import build_tree, TreeArrays
@@ -108,6 +108,11 @@ class GBDT:
 
         F = self.train_set.num_features
         self.B = int(self.train_set.max_num_bin)
+        # resolve hist_impl='auto' EAGERLY, before any jit traces the
+        # tree builder: on TPU this probe-compiles the Pallas kernel once
+        # and falls back to matmul if Mosaic rejects it, so first
+        # hardware contact degrades instead of crashing
+        config._values["hist_impl"] = resolve_impl(config.hist_impl)
         # EFB: bins are bundled [R, G]; histogram sizing follows the
         # bundle lattice, split finding stays in feature space
         bp = self.train_set.bundle_plan
@@ -123,6 +128,21 @@ class GBDT:
                 bp.max_bundle_bins)
         else:
             self.block = block_rows_for(self.train_set.num_data, F, self.B)
+        # histogram-subtraction gate: the per-leaf raw cache (the
+        # HistogramPool analog) must fit the pool budget
+        lattice = (bp.num_bundles * bp.max_bundle_bins if bp is not None
+                   else F * self.B)
+        cache_mb = (config.num_leaves + 1) * lattice * 3 * 4 / 2 ** 20
+        pool_budget = (config.histogram_pool_size
+                       if config.histogram_pool_size > 0 else 512.0)
+        self._hist_sub = bool(config.hist_subtraction) \
+            and cache_mb <= pool_budget
+        if bool(config.hist_subtraction) and not self._hist_sub:
+            from .. import log as _log
+            _log.warning(
+                f"per-leaf histogram cache would need {cache_mb:.0f} MB "
+                f"(> histogram_pool_size budget {pool_budget:.0f} MB); "
+                "disabling histogram subtraction")
         # data-parallel over every local device (tree_learner param,
         # tree_learner.cpp:15 factory analog; "serial" pins one device)
         if bool(config.linear_tree):
@@ -708,7 +728,7 @@ class GBDT:
             max_depth=cfg.max_depth, num_bins=self.B,
             split_params=self.split_params,
             hist_dtype=cfg.hist_dtype, hist_impl=cfg.hist_impl,
-            block_rows=self.block,
+            hist_sub=self._hist_sub, block_rows=self.block,
             valid_bins=tuple(dd.bins for dd in self.valid_dd),
             valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd),
             mono_type_pf=self.mono_type_pf,

@@ -17,11 +17,22 @@ Design (TPU-first; not a translation):
     2. applies them with one vectorized pass over ``row_leaf`` (the
        DataPartition::Split analog — no index reordering, just a dense
        leaf-id relabel),
-    3. builds both children's histograms in ONE one-hot matmul
-       (ops/histogram.py) — with leaf_batch<=21 both-children-direct costs
-       the same MXU time as the reference's smaller-child+subtraction trick
-       because the matmul N dim pads to 128 anyway; an optional
-       subtraction+cache mode is a later optimization,
+    3. builds the SMALLER child's histogram over a compacted,
+       dynamically-bounded row stream and derives the sibling by
+       parent-minus-child subtraction from a per-leaf histogram cache
+       (``hist_sub=True``; serial_tree_learner.cpp:567-592 ``Subtract``
+       + dense_bin.hpp:105 iterating ``data_indices`` only). The matmul
+       N-dim padding argument only covers the LEAF axis; the row stream
+       is the real cost — without subtraction every round re-streams all
+       R rows (~13x/tree at 255 leaves, ~254x in leaf_batch=1 modes).
+       With it, each round streams only the smaller children's rows:
+       compaction defers the bins gather to per-block inside
+       ops/histogram.py, and the block loop is bounded by the live row
+       count, so a round over a 1%-sized leaf pays ~1% of a full pass.
+       The cache holds RAW histograms ([L+1, F, B, 3] f32, int32 when
+       quantized — subtraction stays exact), ~5 MB at Higgs shape;
+       callers disable hist_sub when the cache would not fit
+       (histogram_pool_size analog),
     4. finds the children's best splits (ops/split.py) and scatters them
        into the per-leaf caches.
   ``leaf_batch=1`` reproduces the reference's exact best-first order;
@@ -110,7 +121,7 @@ def _round_int(x):
                      "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins", "mono_method",
-                     "forced"))
+                     "forced", "hist_sub"))
 def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
@@ -136,7 +147,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                bundle_bins: int = 0,
                quant_scales: Optional[jax.Array] = None,
                mono_method: str = "basic",
-               forced: Optional[Tuple] = None):
+               forced: Optional[Tuple] = None,
+               hist_sub: bool = True):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
@@ -322,38 +334,33 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             return h
         return h.astype(f32) * _dq_vec
 
+    def hist_raw_for(slots, rl, gh_in=None, row_gather=None, num_rows=None):
+        """RAW histogram for the given leaf slots — before dequant and
+        EFB unbundling, both of which are LINEAR, so parent-minus-child
+        subtraction happens in this space (exactly, int32, when
+        quantized). mode-specific shape/merge:
+        - feature: [S, F_loc, B, 3], local feature slice, no collective;
+        - voting: [S, F|G, B|bb, 3], LOCAL rows only (merge per elected
+          feature later). EFB composes: unbundling locally commutes with
+          the later psum of elected columns — votes and elections run in
+          feature space, communication stays O(top_k * B);
+        - data/serial: [S, F|G, B|bb, 3], psum-merged over axis_name."""
+        mat = local_bins if mode == "feature" else bins
+        nb_in = bundle_bins if use_bundle else B
+        merge = mode not in ("feature", "voting")
+        return build_histograms(
+            mat, gh if gh_in is None else gh_in, rl, slots,
+            num_bins=nb_in, block_rows=block_rows, axis_name=axis_name,
+            merge=merge, hist_dtype=hist_dtype, impl=hist_impl,
+            row_gather=row_gather, num_rows=num_rows)
+
+    def hist_finish(hraw):
+        """Raw -> per-feature f32 split-finding space."""
+        h = _dequant(hraw)
+        return unbundle(h) if use_bundle else h
+
     def hist_for(slots, rl):
-        if mode == "feature":
-            # local feature slice, all rows on-chip: no collective here
-            return _dequant(build_histograms(
-                local_bins, gh, rl, slots, num_bins=B,
-                block_rows=block_rows, axis_name=axis_name, merge=False,
-                hist_dtype=hist_dtype, impl=hist_impl))
-        if mode == "voting":
-            # local rows only; the merge happens per elected feature.
-            # EFB composes here: the bundle->feature unbundling is linear
-            # in the histogram, so unbundling LOCALLY commutes with the
-            # later psum of elected feature columns — votes and elections
-            # run in feature space, communication stays O(top_k * B).
-            if use_bundle:
-                hg = build_histograms(
-                    bins, gh, rl, slots, num_bins=bundle_bins,
-                    block_rows=block_rows, axis_name=axis_name,
-                    merge=False, hist_dtype=hist_dtype, impl=hist_impl)
-                return unbundle(_dequant(hg))
-            return _dequant(build_histograms(
-                bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
-                axis_name=axis_name, merge=False,
-                hist_dtype=hist_dtype, impl=hist_impl))
-        if use_bundle:
-            hg = build_histograms(
-                bins, gh, rl, slots, num_bins=bundle_bins,
-                block_rows=block_rows, axis_name=axis_name,
-                hist_dtype=hist_dtype, impl=hist_impl)
-            return unbundle(_dequant(hg))
-        return _dequant(build_histograms(
-            bins, gh, rl, slots, num_bins=B, block_rows=block_rows,
-            axis_name=axis_name, hist_dtype=hist_dtype, impl=hist_impl))
+        return hist_finish(hist_raw_for(slots, rl))
 
     def _sync_best(bs):
         """Merge per-shard best splits by gain (SyncUpGlobalBestSplit)."""
@@ -648,7 +655,15 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
 
     # ---------------- root ----------------
     root_slots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(0)
-    hist0 = hist_for(root_slots, row_leaf0)
+    hraw0 = hist_raw_for(root_slots, row_leaf0)
+    hist0 = hist_finish(hraw0)
+    if hist_sub:
+        # per-leaf RAW histogram cache (HistogramPool analog): slot i
+        # holds leaf i's histogram as of its creation; rows of a leaf
+        # only change when IT is split, so entries stay valid until
+        # popped, when the entry is the subtraction minuend
+        state["hist_cache"] = jnp.zeros(
+            (L + 1,) + hraw0.shape[1:], hraw0.dtype).at[0].set(hraw0[0])
     root_sums = hist0[0, 0, :, :].sum(axis=0)       # all rows land in f0 bins
     if mode == "voting":
         # local hist -> global root sums (the Allreduce of root
@@ -743,12 +758,20 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                           jnp.take(st["f_slot_rec"], pjc)))
             f_feat = jnp.take(f_feats_a, fr)
             f_thr = jnp.take(f_thrs_a, fr)
-            fslots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(f_slot)
-            hist_fc = jax.lax.cond(
-                in_forced,
-                lambda: hist_for(fslots, st["row_leaf"]),
-                lambda: jnp.zeros((2 * W, F, B, HIST_CH), jnp.float32))
-            hrow = jnp.take(hist_fc[0], f_feat, axis=0)       # [B, 3]
+            if hist_sub:
+                # the forced leaf's full histogram is already cached
+                # (GatherInfoForThreshold reads the leaf's histogram;
+                # the pool makes the re-histogram pass free)
+                hist_fc0 = hist_finish(
+                    st["hist_cache"][jnp.clip(f_slot, 0, L)][None])[0]
+            else:
+                fslots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(f_slot)
+                hist_fc0 = jax.lax.cond(
+                    in_forced,
+                    lambda: hist_for(fslots, st["row_leaf"]),
+                    lambda: jnp.zeros((2 * W, F, B, HIST_CH),
+                                      jnp.float32))[0]
+            hrow = jnp.take(hist_fc0, f_feat, axis=0)         # [B, 3]
             nb_f = jnp.take(nan_bin_pf, f_feat)
             bval = (jnp.arange(B, dtype=jnp.int32)
                     != jnp.where(nb_f >= 0, nb_f, -1))
@@ -1029,10 +1052,54 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             new_state_extra["cegb_used_rows"] = ur.at[
                 jnp.arange(R), f_r].set(cur | act_r)
 
-        # -- 4. children histograms (both directly; see module docstring)
+        # -- 4. children histograms. hist_sub: the SMALLER child (by raw
+        # row count — that is what bounds the stream) is histogrammed
+        # directly over a compacted, dynamically-bounded row stream; the
+        # sibling is parent minus child from the raw cache
+        # (serial_tree_learner.cpp:567-592 Subtract). Otherwise both
+        # children are histogrammed directly over all R rows.
         slots2w = jnp.concatenate([jnp.where(valid, sel_s, -2),
                                    jnp.where(valid, right_slot, -2)])
-        hist2w = hist_for(slots2w, row_leaf)
+        new_state_hist = {}
+        if hist_sub:
+            rlc_n = jnp.where(row_leaf < 0, DUMMY_LEAF, row_leaf)
+            raw_cnt = jax.ops.segment_sum(
+                jnp.ones((R,), jnp.int32), rlc_n, num_segments=L + 1)
+            if axis_name is not None and mode != "feature":
+                # replicate the small/big choice across row shards: in
+                # data mode the psum inside hist_raw_for sums LOCAL
+                # small-child histograms, so every shard must agree on
+                # which child that is
+                raw_cnt = jax.lax.psum(raw_cnt, axis_name)
+            l_raw = jnp.take(raw_cnt, jnp.clip(sel_s, 0, L))
+            r_raw = jnp.take(raw_cnt, jnp.clip(right_slot, 0, L))
+            small_is_left = l_raw <= r_raw
+            small_slots = jnp.where(
+                valid, jnp.where(small_is_left, sel_s, right_slot), -2)
+            m = (row_leaf[:, None] == small_slots[None, :]).any(axis=1)
+            pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+            n_small = m.astype(jnp.int32).sum()
+            c_idx = jnp.zeros((R,), jnp.int32).at[
+                jnp.where(m, pos, R)].set(
+                jnp.arange(R, dtype=jnp.int32), mode="drop")
+            rl_c = jnp.where(jnp.arange(R, dtype=jnp.int32) < n_small,
+                             jnp.take(row_leaf, c_idx), -1)
+            gh_c = jnp.take(gh, c_idx, axis=0)
+            hsmall = hist_raw_for(small_slots, rl_c, gh_in=gh_c,
+                                  row_gather=c_idx, num_rows=n_small)
+            parent_raw = jnp.take(st["hist_cache"],
+                                  jnp.clip(sel_s, 0, L), axis=0)
+            hbig = parent_raw - hsmall
+            sil = small_is_left.reshape((W,) + (1,) * (hsmall.ndim - 1))
+            left_raw = jnp.where(sil, hsmall, hbig)
+            right_raw = jnp.where(sil, hbig, hsmall)
+            new_state_hist["hist_cache"] = st["hist_cache"] \
+                .at[jnp.where(valid, sel_s, DUMMY_LEAF)].set(left_raw) \
+                .at[jnp.where(valid, right_slot, DUMMY_LEAF)] \
+                .set(right_raw)
+            hist2w = hist_finish(jnp.concatenate([left_raw, right_raw]))
+        else:
+            hist2w = hist_for(slots2w, row_leaf)
         depth2w = jnp.take(leaf_depth,
                            jnp.concatenate([sel_s, right_slot]))
         keyr = (jax.random.fold_in(rng_key, st["r"] + 1)
@@ -1063,7 +1130,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                    bs_rout=bs_rout,
                    leaf_depth=leaf_depth, leaf_lo=leaf_lo, leaf_hi=leaf_hi,
                    r=st["r"] + 1, **new_state_extra, **new_state_mono,
-                   **new_state_forced)
+                   **new_state_forced, **new_state_hist)
         return out
 
     state = jax.lax.while_loop(cond, body, state)

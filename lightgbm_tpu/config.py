@@ -170,6 +170,17 @@ PARAMS: Dict[str, ParamSpec] = {
            doc="histogram kernel: auto (pallas on tpu, scatter on cpu), "
                "matmul (MXU one-hot), scatter (XLA scatter-add), pallas "
                "(fused VMEM kernel)"),
+        _p("hist_subtraction", True, bool,
+           doc="histogram the smaller child only and derive the sibling "
+               "by parent-minus-child subtraction from a per-leaf cache "
+               "(serial_tree_learner.cpp:567 Subtract analog); "
+               "auto-disabled when the cache exceeds "
+               "histogram_pool_size"),
+        _p("histogram_pool_size", -1.0, float,
+           aliases=("hist_pool_size",),
+           doc="MB budget for the per-leaf histogram cache "
+               "(config.h histogram_pool_size analog); <=0 means an "
+               "automatic 512 MB device budget"),
         # -- IO / dataset --
         _p("max_bin", 255, int, aliases=("max_bins",), check=lambda v: v > 1),
         _p("max_bin_by_feature", [], list),

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["build_histograms", "HIST_CH"]
+__all__ = ["build_histograms", "resolve_impl", "HIST_CH"]
 
 # channels per histogram cell: (sum_grad, sum_hess, count)
 HIST_CH = 3
@@ -65,6 +65,68 @@ def _pvary(x, axis_name):
     return jax.lax.pvary(x, axis_name)  # older jax
 
 
+# Pallas training-path survivability: the fused kernel has never met a
+# given chip's Mosaic toolchain until first hardware contact, and the
+# reference's equivalent defense is a GPU->CPU treelearner fallback
+# (gpu_tree_learner.cpp logs and degrades rather than aborting). The
+# verdict is probed ONCE, eagerly, and cached for the process.
+_PALLAS_TRAIN_OK: Optional[bool] = None
+
+
+def _reset_pallas_probe() -> None:
+    """Forget the cached Pallas probe verdict (tests only)."""
+    global _PALLAS_TRAIN_OK
+    _PALLAS_TRAIN_OK = None
+
+
+def _probe_pallas_training() -> bool:
+    """Compile + run a tiny Pallas histogram eagerly, once; cache verdict.
+
+    Mosaic may reject the kernel on a chip/toolchain this code has never
+    met; default-params training must degrade to the matmul formulation
+    instead of crashing. Runs eagerly so the verdict exists before any
+    outer jit traces ``build_histograms``.
+    """
+    global _PALLAS_TRAIN_OK
+    if _PALLAS_TRAIN_OK is None:
+        try:
+            from . import pallas_histogram
+            r, l = 256, 2
+            out = pallas_histogram.build_histograms_pallas(
+                jnp.zeros((r, 2), jnp.uint8),
+                jnp.ones((r, HIST_CH), jnp.float32),
+                jnp.zeros((r,), jnp.int32),
+                jnp.arange(l, dtype=jnp.int32),
+                num_bins=4, hist_dtype="bfloat16")
+            jax.block_until_ready(out)
+            _PALLAS_TRAIN_OK = True
+        except Exception as e:  # Mosaic lowering / runtime rejection
+            from .. import log as _log
+            _log.warning(
+                "Pallas histogram kernel unavailable on this backend "
+                f"({type(e).__name__}: {e}); falling back to the XLA "
+                "matmul formulation")
+            _PALLAS_TRAIN_OK = False
+    return _PALLAS_TRAIN_OK
+
+
+def resolve_impl(impl: str) -> str:
+    """Resolve ``hist_impl='auto'`` to a concrete kernel for this backend.
+
+    Call eagerly (GBDT setup does) before any tracing: on TPU the Pallas
+    kernel is the default but only after a one-time probe compile proves
+    Mosaic accepts it — otherwise the matmul formulation.
+    """
+    if impl != "auto":
+        return impl
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return "scatter"     # XLA lowers the scatter to per-row adds
+    if backend == "tpu":
+        return "pallas" if _probe_pallas_training() else "matmul"
+    return "matmul"
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_bins", "block_rows", "axis_name", "hist_dtype",
@@ -73,7 +135,9 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                      leaf_ids: jax.Array, *, num_bins: int,
                      block_rows: int = 0, axis_name: Optional[str] = None,
                      hist_dtype: str = "bfloat16",
-                     impl: str = "auto", merge: bool = True) -> jax.Array:
+                     impl: str = "auto", merge: bool = True,
+                     row_gather: Optional[jax.Array] = None,
+                     num_rows: Optional[jax.Array] = None) -> jax.Array:
     """Accumulate per-(leaf, feature, bin) sums of (grad, hess, count).
 
     Args:
@@ -110,6 +174,20 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
     host-side in GBDT (the analog of the reference's per-leaf
     int16->int32 escalation, which the MXU makes unnecessary).
 
+    Dynamic row stream (the histogram-subtraction companion, VERDICT r3
+    #2 — the analog of dense_bin.hpp:105 iterating ``data_indices``
+    only): ``row_gather`` [R] int32 is a compacted row-index order for
+    ``bins`` — ``gh`` and ``row_leaf`` are passed ALREADY compacted by
+    the caller (they are narrow; bins is the wide stream whose gather is
+    deferred to per-block, so unprocessed blocks never touch it).
+    ``num_rows`` (traced scalar) bounds the stream: only
+    ``ceil(num_rows / block_rows)`` blocks are processed via a
+    dynamically-bounded loop — rows past ``num_rows`` must carry
+    ``row_leaf == -1``. Works inside shard_map: each shard bounds its
+    own stream; the psum after the loop re-syncs. The Pallas path
+    honors ``row_gather`` by materializing the gathered bins (correct
+    but not yet a bandwidth win; its grid is static).
+
     Returns: [L, F, B, 3] float32 (int32 when gh is int8).
     """
     R, F = bins.shape
@@ -124,18 +202,16 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
     nb = R // block_rows
     cdt = jnp.dtype(hist_dtype)
     if impl == "auto":
-        backend = jax.default_backend()
-        if backend == "tpu":
-            impl = "pallas"      # fused VMEM one-hot (pallas_histogram)
-        elif backend == "cpu":
-            impl = "scatter"     # XLA lowers to per-row adds
-        else:
-            impl = "matmul"
+        # resolves at trace time (impl is static); the Pallas probe cache
+        # is normally warmed eagerly by GBDT setup via resolve_impl
+        impl = resolve_impl(impl)
 
     if impl == "pallas":
         from .pallas_histogram import build_histograms_pallas
+        bins_p = (jnp.take(bins, row_gather, axis=0)
+                  if row_gather is not None else bins)
         hist = build_histograms_pallas(
-            bins, gh, row_leaf, leaf_ids, num_bins=B,
+            bins_p, gh, row_leaf, leaf_ids, num_bins=B,
             hist_dtype=hist_dtype)
         if axis_name is not None:
             hist = jax.lax.psum(hist, axis_name)
@@ -145,17 +221,31 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
     adt = jnp.int8 if quant else cdt
     acc_dt = jnp.int32 if quant else jnp.float32
 
-    bins_b = bins.reshape(nb, block_rows, F)
-    gh_b = gh.reshape(nb, block_rows, HIST_CH)
-    leaf_b = row_leaf.reshape(nb, block_rows)
+    # dynamically-bounded stream: process only the blocks that hold live
+    # rows, via fori_loop; otherwise a full static scan (cheapest trace)
+    dyn = (num_rows is not None) or (row_gather is not None)
+    if num_rows is not None:
+        nb_used = jnp.clip((num_rows + block_rows - 1) // block_rows, 0, nb)
+    else:
+        nb_used = nb
+
+    def _block(i):
+        s = i * block_rows
+        if row_gather is not None:
+            idx = jax.lax.dynamic_slice(row_gather, (s,), (block_rows,))
+            bb = jnp.take(bins, idx, axis=0)
+        else:
+            bb = jax.lax.dynamic_slice(bins, (s, 0), (block_rows, F))
+        ghb = jax.lax.dynamic_slice(gh, (s, 0), (block_rows, HIST_CH))
+        lb = jax.lax.dynamic_slice(row_leaf, (s,), (block_rows,))
+        return bb, ghb, lb
 
     iota_b = jnp.arange(B, dtype=jnp.int32)
 
     if impl == "scatter":
         iota_f = jnp.arange(F, dtype=jnp.int32)
 
-        def body_scatter(acc, inputs):
-            bb, ghb, lb = inputs
+        def accum_scatter(acc, bb, ghb, lb):
             eq = lb[:, None] == leaf_ids[None, :]
             li = jnp.argmax(eq, axis=1)
             li = jnp.where(jnp.any(eq, axis=1), li, L)  # L = spill slot
@@ -168,21 +258,28 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                 vals = ghb.astype(cdt).astype(jnp.float32)
             vals = jnp.broadcast_to(
                 vals[:, None, :], (block_rows, F, HIST_CH))
-            acc = acc.at[flat.reshape(-1)].add(
+            return acc.at[flat.reshape(-1)].add(
                 vals.reshape(block_rows * F, HIST_CH))
-            return acc, None
 
         acc0 = jnp.zeros(((L + 1) * F * B, HIST_CH), dtype=acc_dt)
         if axis_name is not None:
             acc0 = _pvary(acc0, axis_name)
-        acc, _ = jax.lax.scan(body_scatter, acc0, (bins_b, gh_b, leaf_b))
+        if dyn:
+            acc = jax.lax.fori_loop(
+                0, nb_used,
+                lambda i, a: accum_scatter(a, *_block(i)), acc0)
+        else:
+            acc, _ = jax.lax.scan(
+                lambda a, xs: (accum_scatter(a, *xs), None), acc0,
+                (bins.reshape(nb, block_rows, F),
+                 gh.reshape(nb, block_rows, HIST_CH),
+                 row_leaf.reshape(nb, block_rows)))
         hist = acc[:L * F * B].reshape(L, F, B, HIST_CH)
         if axis_name is not None and merge:
             hist = jax.lax.psum(hist, axis_name)
         return hist
 
-    def body(acc, inputs):
-        bb, ghb, lb = inputs
+    def accum(acc, bb, ghb, lb):
         onehot = (bb.astype(jnp.int32)[:, :, None] == iota_b).astype(adt)
         onehot = onehot.reshape(block_rows, F * B)
         mask = (lb[:, None] == leaf_ids[None, :]).astype(adt)
@@ -191,18 +288,25 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         # float32 mode must not silently drop to the MXU's bf16 passes
         prec = (jax.lax.Precision.HIGHEST if cdt == jnp.float32
                 else jax.lax.Precision.DEFAULT)
-        acc = acc + jax.lax.dot(
+        return acc + jax.lax.dot(
             onehot.T, ghl,
             precision=None if quant else prec,
             preferred_element_type=acc_dt)
-        return acc, None
 
     acc0 = jnp.zeros((F * B, L * HIST_CH), dtype=acc_dt)
     if axis_name is not None:
         # inside shard_map the blocked inputs vary over the mapped axis;
-        # the scan carry must carry the same varying-axis type
+        # the loop carry must carry the same varying-axis type
         acc0 = _pvary(acc0, axis_name)
-    acc, _ = jax.lax.scan(body, acc0, (bins_b, gh_b, leaf_b))
+    if dyn:
+        acc = jax.lax.fori_loop(
+            0, nb_used, lambda i, a: accum(a, *_block(i)), acc0)
+    else:
+        acc, _ = jax.lax.scan(
+            lambda a, xs: (accum(a, *xs), None), acc0,
+            (bins.reshape(nb, block_rows, F),
+             gh.reshape(nb, block_rows, HIST_CH),
+             row_leaf.reshape(nb, block_rows)))
     hist = acc.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
     if axis_name is not None and merge:
         # cross-chip merge over ICI — replaces Network::ReduceScatter +

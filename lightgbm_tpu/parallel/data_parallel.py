@@ -155,7 +155,8 @@ class DataParallelPlan:
                    rng_key=None, feature_fraction_bynode: float = 1.0,
                    bundle_meta=None, bundle_bins: int = 0,
                    quant_scales=None, mono_method: str = "basic",
-                   cat_sorted_mask=None, forced=None):
+                   cat_sorted_mask=None, forced=None,
+                   hist_sub: bool = True):
         return build_tree_dp(
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask, num_leaves=num_leaves,
@@ -170,7 +171,8 @@ class DataParallelPlan:
             parallel_mode=self.parallel_mode, top_k=self.top_k,
             bundle_meta=bundle_meta, bundle_bins=bundle_bins,
             quant_scales=quant_scales, mono_method=mono_method,
-            cat_sorted_mask=cat_sorted_mask, forced=forced)
+            cat_sorted_mask=cat_sorted_mask, forced=forced,
+            hist_sub=hist_sub)
 
 
 class VotingParallelPlan(DataParallelPlan):
@@ -238,7 +240,7 @@ class FeatureParallelPlan:
                    mono_type_pf=None, interaction_groups=None,
                    rng_key=None, feature_fraction_bynode: float = 1.0,
                    quant_scales=None, mono_method: str = "basic",
-                   cat_sorted_mask=None):
+                   cat_sorted_mask=None, hist_sub: bool = True):
         has_mono = mono_type_pf is not None
         mono_arr = (mono_type_pf if has_mono
                     else jnp.zeros_like(num_bins_pf))
@@ -253,7 +255,8 @@ class FeatureParallelPlan:
             hist_dtype=hist_dtype, hist_impl=hist_impl,
             block_rows=block_rows, n_shards=self.num_shards,
             has_mono=has_mono, mono_method=mono_method,
-            feature_fraction_bynode=feature_fraction_bynode)
+            feature_fraction_bynode=feature_fraction_bynode,
+            hist_sub=hist_sub)
 
 
 @functools.partial(
@@ -261,14 +264,14 @@ class FeatureParallelPlan:
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype",
                      "hist_impl", "block_rows", "n_shards", "has_mono",
-                     "mono_method", "feature_fraction_bynode"))
+                     "mono_method", "feature_fraction_bynode", "hist_sub"))
 def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, mono_arr,
                        fp_extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl,
                        block_rows, n_shards, has_mono, mono_method="basic",
-                       feature_fraction_bynode=1.0):
+                       feature_fraction_bynode=1.0, hist_sub=True):
     R, F = bins.shape
     # pad the feature axis so it splits evenly; pad features are trivial
     # (1 bin, masked out) and never selected
@@ -309,7 +312,7 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             local_meta=(loc_nbpf, loc_nanpf, loc_catpf, loc_fmask,
                         loc_mono if has_mono else None),
             feat_offset=offset, quant_scales=qs,
-            mono_method=mono_method)
+            mono_method=mono_method, hist_sub=hist_sub)
 
     # replicated extras padded to the sharded feature width
     qs, groups, key, csm = fp_extras
@@ -342,14 +345,14 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                      "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "n_valid", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins",
-                     "mono_method", "forced"))
+                     "mono_method", "forced", "hist_sub"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl, block_rows,
                        n_valid, feature_fraction_bynode,
                        parallel_mode="data", top_k=20, bundle_bins=0,
-                       mono_method="basic", forced=None):
+                       mono_method="basic", forced=None, hist_sub=True):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
@@ -371,7 +374,7 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             parallel_mode=parallel_mode, top_k=top_k,
             bundle_meta=bmeta, bundle_bins=bundle_bins,
             quant_scales=qs, mono_method=mono_method,
-            cat_sorted_mask=csm, forced=forced)
+            cat_sorted_mask=csm, forced=forced, hist_sub=hist_sub)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
@@ -403,7 +406,8 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   parallel_mode: str = "data", top_k: int = 20,
                   bundle_meta=None, bundle_bins: int = 0,
                   quant_scales=None, mono_method: str = "basic",
-                  cat_sorted_mask=None, forced=None):
+                  cat_sorted_mask=None, forced=None,
+                  hist_sub: bool = True):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
@@ -423,4 +427,5 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
         n_valid=len(valid_bins),
         feature_fraction_bynode=feature_fraction_bynode,
         parallel_mode=parallel_mode, top_k=top_k,
-        bundle_bins=bundle_bins, mono_method=mono_method, forced=forced)
+        bundle_bins=bundle_bins, mono_method=mono_method, forced=forced,
+        hist_sub=hist_sub)

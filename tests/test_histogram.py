@@ -121,3 +121,93 @@ def test_pallas_interpret_matches_oracle(rng):
         jnp.asarray(leaf_ids), num_bins=16, hist_dtype="bfloat16",
         interpret=True))
     np.testing.assert_allclose(pls, xla, rtol=1e-5, atol=1e-5)
+
+
+def test_auto_impl_pallas_fallback(monkeypatch):
+    """hist_impl='auto' on TPU must survive a Mosaic rejection of the
+    Pallas kernel: the probe fails once, logs, and resolves to matmul
+    (VERDICT r3: first hardware contact must not crash default-params
+    training)."""
+    from lightgbm_tpu.ops import histogram as H
+    from lightgbm_tpu.ops import pallas_histogram as PH
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic lowering rejected the kernel")
+
+    monkeypatch.setattr(PH, "build_histograms_pallas", boom)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    H._reset_pallas_probe()
+    try:
+        assert H.resolve_impl("auto") == "matmul"
+        # verdict is cached: a second resolve does not re-probe
+        monkeypatch.setattr(
+            PH, "build_histograms_pallas",
+            lambda *a, **k: (_ for _ in ()).throw(AssertionError("re-probe")))
+        assert H.resolve_impl("auto") == "matmul"
+    finally:
+        H._reset_pallas_probe()
+    # explicit request is honored un-probed (user opted in)
+    assert H.resolve_impl("pallas") == "pallas"
+
+
+def test_auto_impl_pallas_accepted(monkeypatch):
+    """When the probe compile succeeds, auto->pallas on TPU."""
+    import jax.numpy as jnp_
+    from lightgbm_tpu.ops import histogram as H
+    from lightgbm_tpu.ops import pallas_histogram as PH
+
+    monkeypatch.setattr(
+        PH, "build_histograms_pallas",
+        lambda *a, num_bins, hist_dtype: jnp_.zeros(
+            (2, 2, num_bins, 3), jnp_.float32))
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    H._reset_pallas_probe()
+    try:
+        assert H.resolve_impl("auto") == "pallas"
+    finally:
+        H._reset_pallas_probe()
+
+
+def test_auto_impl_cpu_is_scatter(monkeypatch):
+    from lightgbm_tpu.ops import histogram as H
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert H.resolve_impl("auto") == "scatter"
+
+
+def test_subtraction_tree_matches_direct(rng):
+    """hist_sub=True (smaller-child + parent-minus-child subtraction
+    over a compacted dynamic row stream) must grow the same tree as the
+    both-children-direct path (float32 hist: subtraction differs only
+    by f32 associativity)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.boosting.tree_builder import build_tree
+    from lightgbm_tpu.ops.split import SplitParams
+
+    R, F, B = 2048, 8, 32
+    bins = rng.randint(0, B, size=(R, F)).astype(np.uint8)
+    y = rng.normal(size=R)
+    g = (y - y.mean()).astype(np.float32)
+    gh = np.stack([g, np.ones(R, np.float32),
+                   np.ones(R, np.float32)], axis=1)
+    meta = dict(
+        num_bins_pf=jnp.full((F,), B, jnp.int32),
+        nan_bin_pf=jnp.full((F,), -1, jnp.int32),
+        is_cat_pf=jnp.zeros((F,), bool),
+        feature_mask=jnp.ones((F,), bool))
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    trees = {}
+    for sub in (True, False):
+        t, rl, _ = build_tree(
+            jnp.asarray(bins), jnp.asarray(gh),
+            jnp.zeros((R,), jnp.int32), meta["num_bins_pf"],
+            meta["nan_bin_pf"], meta["is_cat_pf"], meta["feature_mask"],
+            num_leaves=31, leaf_batch=4, max_depth=-1, num_bins=B,
+            split_params=sp, hist_dtype="float32", hist_impl="scatter",
+            block_rows=256, hist_sub=sub)
+        trees[sub] = (np.asarray(t.split_feature), np.asarray(t.threshold_bin),
+                      np.asarray(t.leaf_values), np.asarray(rl))
+    np.testing.assert_array_equal(trees[True][0], trees[False][0])
+    np.testing.assert_array_equal(trees[True][1], trees[False][1])
+    np.testing.assert_allclose(trees[True][2], trees[False][2],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(trees[True][3], trees[False][3])
