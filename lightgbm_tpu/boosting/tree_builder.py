@@ -743,7 +743,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         if use_forced:
             # ForceSplits rounds: override lane 0 with the forced
             # candidate computed straight from the slot's histogram
-            # (GatherInfoForThreshold analog; missing routes right).
+            # (GatherInfoForThreshold analog; missing routes LEFT with
+            # default_left=true, feature_histogram.hpp:588).
             # A dropped forced candidate falls back to this round's
             # normal top-gain pop and poisons its forced descendants.
             fr = jnp.clip(st["r"], 0, n_forced - 1)
@@ -773,11 +774,23 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                                       jnp.float32))[0]
             hrow = jnp.take(hist_fc0, f_feat, axis=0)         # [B, 3]
             nb_f = jnp.take(nan_bin_pf, f_feat)
+            # GatherInfoForThresholdNumericalInner accumulates the RIGHT
+            # side from the top bin down to threshold+1, SKIPPING the
+            # NaN bin (feature_histogram.hpp:522-526 use_na_as_missing)
+            # — so missing rows land LEFT and default_left=true below.
+            # (MISSING_ZERO's zero bin stays an ordinary bin here, the
+            # same treatment this implementation's regular split finder
+            # gives it.)
             bval = (jnp.arange(B, dtype=jnp.int32)
                     != jnp.where(nb_f >= 0, nb_f, -1))
             cum = jnp.cumsum(jnp.where(bval[:, None], hrow, 0.0), axis=0)
             tot = hrow.sum(axis=0)
-            lsum = jnp.take(cum, jnp.clip(f_thr, 0, B - 1), axis=0)
+            nan_row = jnp.where(
+                nb_f >= 0,
+                jnp.take(hrow, jnp.clip(nb_f, 0, B - 1), axis=0),
+                jnp.zeros((HIST_CH,), jnp.float32))
+            lsum = (jnp.take(cum, jnp.clip(f_thr, 0, B - 1), axis=0)
+                    + nan_row)
             rsum = tot - lsum
             l1_, l2_ = sp.lambda_l1, sp.lambda_l2
             node_of_f = jnp.take(t.leaf2node,
@@ -805,7 +818,8 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                     & (rsum[2] >= sp.min_data_in_leaf)
                     & (lsum[1] >= sp.min_sum_hessian_in_leaf)
                     & (rsum[1] >= sp.min_sum_hessian_in_leaf)
-                    & (f_gain >= 0)
+                    & (f_gain > 0)   # strict: gain <= min_gain_shift
+                                     # is rejected (hpp:562)
                     & ((max_depth <= 0) | (depth_f < max_depth))
                     & (jnp.take(t.leaf2node, f_slot) != DUMMY_NODE))
             new_state_forced = dict(
@@ -832,7 +846,7 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                                DUMMY_NODE)
             sfeat = _ov(sfeat, f_feat)
             sthr = _ov(sthr, f_thr)
-            sdl = _ov(sdl, False)
+            sdl = _ov(sdl, True)   # forced numerical: missing left
             scat = _ov(scat, False)
             sgain = _ov(sgain, f_gain)
             slsum = slsum.at[0].set(jnp.where(ok_f, lsum, slsum[0]))

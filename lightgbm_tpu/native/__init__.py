@@ -100,6 +100,11 @@ def parse_libsvm(lines, num_features_hint: int = 0):
     mx = int(lib.lgbtpu_libsvm_max_index(body, n))
     if mx == -2:
         return None
+    if mx < 0 and num_features_hint <= 0:
+        # label-only file with no width hint: the Python fallback
+        # produces a 0-column matrix here; defer to it rather than
+        # invent a clamped 1-column shape
+        return None
     ncols = max(mx + 1, num_features_hint, 1)
     labels = np.empty(len(lines), dtype=np.float64)
     out = np.zeros((len(lines), ncols), dtype=np.float64)

@@ -56,8 +56,12 @@ static int token_value_mode(const char *a, const char *b, double *out,
         *out = NAN;
         return 0;
     }
-    for (long i = 0; i < len; i++)
+    for (long i = 0; i < len; i++) {
         if (a[i] == 'x' || a[i] == 'X') return 1;  /* no hex floats */
+        /* strtod accepts C99 "nan(tag)"; Python float() does not —
+         * reject so both paths fail the token identically */
+        if (a[i] == '(' || a[i] == ')') return 1;
+    }
     if (len >= 63) return 1;
     char tmp[64];
     memcpy(tmp, a, len);

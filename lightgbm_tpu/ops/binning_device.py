@@ -32,16 +32,15 @@ __all__ = ["device_bin_dense", "want_device_binning"]
 
 
 def want_device_binning(num_rows: int, num_features: int) -> bool:
-    if os.environ.get("LIGHTGBM_TPU_DEVICE_BIN") == "1":
-        return True
-    if os.environ.get("LIGHTGBM_TPU_DEVICE_BIN") == "0":
-        return False
-    try:
-        backend = jax.default_backend()
-    except Exception:
-        return False
-    # on CPU XLA has no parallelism edge over the NumPy path
-    return backend != "cpu" and num_rows * num_features >= (1 << 20)
+    """Opt-in only (LIGHTGBM_TPU_DEVICE_BIN=1): the device kernel bins
+    in f32, so a value within f32 eps of a bin boundary can land in a
+    different bin than the host f64 path gives — the same dataset would
+    silently train differently on accelerator vs CPU hosts. The host
+    path is the reproducible default; flip it on for throwaway/bench
+    runs where binning wall-time matters more than bit-reproducibility
+    (=1 forces the device kernel on any backend — the parity tests
+    rely on that)."""
+    return os.environ.get("LIGHTGBM_TPU_DEVICE_BIN") == "1"
 
 
 @functools.partial(jax.jit, static_argnames=("out_dtype",))

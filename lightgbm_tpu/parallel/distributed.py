@@ -139,21 +139,28 @@ def sync_bin_mappers(bin_mappers: List) -> List:
         np.asarray(cat_off, np.float64),
         np.concatenate(scal) if scal else np.empty(0),
         np.concatenate(ubs) if ubs else np.empty(0),
-        (np.concatenate(cats) if cats else np.empty(0,
-                                                    np.int64)).astype(
-            np.float64),
+        # categorical ids are int64: ship the raw BITS through the f64
+        # payload (a float64 cast silently rounds values >= 2^53)
+        (np.concatenate(cats) if cats else np.empty(0, np.int64))
+        .astype(np.int64).view(np.float64),
     ])
-    # pad to the max payload size so the allgather is rectangular
+    # pad to the max payload size so the allgather is rectangular.
+    # The payload travels as RAW BYTES (uint8): process_allgather
+    # device_puts its input, and with jax's default x64-disabled config
+    # a float64 array would be silently canonicalized to float32 —
+    # corrupting bin bounds and the int64 bit-views alike. uint8
+    # round-trips exactly.
     sizes = multihost_utils.process_allgather(
-        np.asarray([payload.size], np.int64))
+        np.asarray([payload.size], np.int32))
     maxlen = int(sizes.max())
     buf = np.zeros(maxlen, np.float64)
     buf[:payload.size] = payload
-    gathered = multihost_utils.process_allgather(buf)      # [P, maxlen]
+    gathered = multihost_utils.process_allgather(buf.view(np.uint8))
 
     merged: List = [None] * F
     for p in range(P):
-        row = np.asarray(gathered[p])
+        row = np.ascontiguousarray(
+            np.asarray(gathered[p])).view(np.float64)
         nf, ns_p = int(row[0]), int(row[1])
         pos = 2
         ub_off_p = row[pos:pos + nf + 1].astype(np.int64)
@@ -164,7 +171,8 @@ def sync_bin_mappers(bin_mappers: List) -> List:
         pos += nf * ns_p
         ub_p = row[pos:pos + ub_off_p[-1]]
         pos += int(ub_off_p[-1])
-        cat_p = row[pos:pos + cat_off_p[-1]].astype(np.int64)
+        cat_p = np.ascontiguousarray(
+            row[pos:pos + cat_off_p[-1]]).view(np.int64)
         for j, f in enumerate(blocks[p]):
             merged[f] = BinMapper.from_state_arrays(
                 scal_p[j], ub_p[ub_off_p[j]:ub_off_p[j + 1]],

@@ -93,3 +93,24 @@ def test_end_to_end_file_training_uses_native(tmp_path, rng):
     bst2 = lgb.train({"objective": "binary", "num_leaves": 7,
                       "verbosity": -1}, lgb.Dataset(str(data)), 5)
     np.testing.assert_allclose(p_native, bst2.predict(X))
+
+
+def test_nan_tag_token_rejected_by_both_paths():
+    """strtod accepts C99 "nan(tag)"; Python float() does not. The
+    native path must reject it (returning None -> fallback) instead of
+    silently parsing NaN where the Python path errors."""
+    _fresh(disable=False)
+    from lightgbm_tpu import native
+    lines = ["1,nan(0x7),2.0", "0,0.1,0.2"]
+    assert native.parse_delimited(lines, ",") is None
+    from lightgbm_tpu.io import _parse_delimited
+    with pytest.raises(ValueError):
+        _parse_delimited(lines, ",")
+
+
+def test_label_only_libsvm_shapes_agree():
+    """Label-only LibSVM lines with no width hint: native defers to the
+    Python fallback instead of inventing a 1-column matrix."""
+    _fresh(disable=False)
+    from lightgbm_tpu import native
+    assert native.parse_libsvm(["1", "0"], num_features_hint=0) is None
