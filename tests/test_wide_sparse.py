@@ -1,0 +1,86 @@
+"""Allstate-shaped wide-sparse coverage (VERDICT r3 #3): the device bin
+storage is dense [R, G], so wide one-hot data is feasible exactly when
+EFB compresses it — the same mechanism the reference's own Allstate
+experiment leans on (docs/Experiments.rst:121; EFB is built for
+mutually-exclusive one-hot blocks). These tests pin the claimed bound:
+a >=2k-one-hot-feature dataset must bundle down to ~the number of
+underlying categorical variables, keep device bytes under budget, and
+train identically to the unbundled path."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+scipy_sparse = pytest.importorskip("scipy.sparse")
+
+
+def _one_hot_sparse(rng, n_rows, n_vars, card):
+    """n_vars categorical variables, each one-hot into `card` columns:
+    n_vars * card total columns, exactly one nonzero per (row, var)."""
+    cats = rng.randint(0, card, size=(n_rows, n_vars))
+    cols = (cats + np.arange(n_vars)[None, :] * card).ravel()
+    rows = np.repeat(np.arange(n_rows), n_vars)
+    data = np.ones(n_rows * n_vars, np.float64)
+    X = scipy_sparse.csr_matrix(
+        (data, (rows, cols)), shape=(n_rows, n_vars * card))
+    return X, cats
+
+
+@pytest.mark.slow
+def test_allstate_shape_bundles_and_fits_budget(rng):
+    n_rows, n_vars, card = 100_000, 128, 16       # 2048 one-hot columns
+    X, cats = _one_hot_sparse(rng, n_rows, n_vars, card)
+    w = rng.normal(size=n_vars)
+    y = (w[None, :] * (cats == 0)).sum(axis=1) \
+        + 0.1 * rng.normal(size=n_rows)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    ds.construct()
+    plan = ds.bundle_plan
+    assert plan is not None, "EFB must engage on one-hot-wide data"
+    # exactly exclusive blocks: bundles ~= number of underlying vars
+    assert plan.num_bundles <= 2 * n_vars
+    # device storage is the BUNDLED matrix: bytes bounded far below dense
+    assert ds.bins.shape == (ds.num_data, plan.num_bundles)
+    dense_bytes = n_rows * n_vars * card
+    assert ds.bins.nbytes <= dense_bytes // 8, (
+        f"device bytes {ds.bins.nbytes} vs dense {dense_bytes}")
+
+
+def test_wide_sparse_training_matches_unbundled(rng):
+    n_rows, n_vars, card = 20_000, 64, 16         # 1024 one-hot columns
+    X, cats = _one_hot_sparse(rng, n_rows, n_vars, card)
+    w = rng.normal(size=n_vars)
+    y = ((w[None, :] * (cats <= 1)).sum(axis=1)
+         + 0.05 * rng.normal(size=n_rows))
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1, "min_data_in_leaf": 20}
+    bst_efb = lgb.train(params, lgb.Dataset(
+        X, label=y, free_raw_data=False), 3)
+    assert bst_efb._gbdt.train_set.bundle_plan is not None
+    bst_dense = lgb.train(dict(params, enable_bundle=False), lgb.Dataset(
+        X, label=y, free_raw_data=False), 3)
+    assert bst_dense._gbdt.train_set.bundle_plan is None
+    # FixHistogram reconstruction is exact: same trees either way
+    Xq = X[:2048]
+    np.testing.assert_allclose(bst_efb.predict(Xq),
+                               bst_dense.predict(Xq),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_wide_sparse_non_exclusive_still_trains(rng):
+    """Sparse but NOT mutually exclusive columns: EFB may bundle only
+    partially (conflict-bounded); training must still work, just with a
+    wider device matrix — the documented dense-storage limit."""
+    n_rows, n_cols = 5_000, 256
+    density = 0.05
+    mask = rng.rand(n_rows, n_cols) < density
+    vals = rng.normal(size=(n_rows, n_cols)) * mask
+    X = scipy_sparse.csr_matrix(vals)
+    y = vals[:, 0] * 2.0 + vals[:, 1:4].sum(axis=1) \
+        + 0.1 * rng.normal(size=n_rows)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(
+        X, label=y, free_raw_data=False), 5)
+    r2 = 1 - np.mean((bst.predict(X[:2000]) - y[:2000]) ** 2) / np.var(y)
+    assert r2 > 0.3
