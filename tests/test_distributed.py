@@ -243,3 +243,116 @@ def test_launcher_fail_fast(tmp_path):
     bad.write_text("import sys; sys.exit(3)\n")
     rc = launch([str(bad)], num_processes=2)
     assert rc == 3
+
+
+def test_parse_hostfile(tmp_path):
+    from lightgbm_tpu.launch import parse_hostfile
+    hf = tmp_path / "hosts.txt"
+    hf.write_text(
+        "# cluster A\n"
+        "10.0.0.1 slots=2\n"
+        "\n"
+        "10.0.0.2   # head node comment\n"
+        "localhost slots=3\n")
+    assert parse_hostfile(str(hf)) == [
+        ("10.0.0.1", 2), ("10.0.0.2", 1), ("localhost", 3)]
+    bad = tmp_path / "bad.txt"
+    bad.write_text("10.0.0.1 cpus=4\n")
+    with pytest.raises(ValueError, match="unrecognized token"):
+        parse_hostfile(str(bad))
+    empty = tmp_path / "empty.txt"
+    empty.write_text("# nothing\n")
+    with pytest.raises(ValueError, match="no hosts"):
+        parse_hostfile(str(empty))
+
+
+def test_launch_hosts_builds_ssh_and_local_commands(monkeypatch):
+    """Remote ranks wrap in ssh with exported rank env; local ranks
+    spawn directly; ranks number across hosts in hostfile order."""
+    from lightgbm_tpu import launch as L
+    spawned = []
+
+    class FakeProc:
+        def __init__(self, cmd, env=None):
+            spawned.append((cmd, env))
+        def poll(self):
+            return 0
+        def kill(self):
+            pass
+        def wait(self):
+            return 0
+        def send_signal(self, sig):
+            pass
+
+    rc = L.launch_hosts(
+        ["train.py", "--foo"], [("10.0.0.1", 2), ("localhost", 1)],
+        port=4001, ssh="ssh", python_exe="python3", _popen=FakeProc)
+    assert rc == 0
+    with pytest.raises(ValueError, match="routable"):
+        L.launch_hosts(["t.py"], [("localhost", 1), ("10.0.0.2", 1)],
+                       _popen=FakeProc)
+    assert len(spawned) == 3
+    # remote ranks 0,1 on 10.0.0.1 via ssh
+    for r in (0, 1):
+        cmd, env = spawned[r]
+        assert cmd[0] == "ssh" and cmd[4] == "10.0.0.1"
+        assert "-tt" in cmd and "BatchMode=yes" in cmd
+        inner = cmd[5]
+        assert f"LIGHTGBM_TPU_RANK={r}" in inner
+        assert "LIGHTGBM_TPU_COORDINATOR=10.0.0.1:4001" in inner
+        assert "LIGHTGBM_TPU_NUM_PROCESSES=3" in inner
+        assert inner.endswith("python3 train.py --foo")
+    # local rank 2 spawns directly with env vars
+    cmd, env = spawned[2]
+    assert cmd == ["python3", "train.py", "--foo"]
+    assert env["LIGHTGBM_TPU_RANK"] == "2"
+    assert env["LIGHTGBM_TPU_COORDINATOR"] == "10.0.0.1:4001"
+    assert env["LIGHTGBM_TPU_NUM_PROCESSES"] == "3"
+
+
+_VOTING_WORKER = textwrap.dedent("""
+    import os, sys
+    outdir, repo = sys.argv[1], sys.argv[2]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    sys.path.insert(0, repo)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from lightgbm_tpu.parallel.distributed import init_distributed
+    init_distributed()
+    assert jax.process_count() == 4
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(800, 6))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "tree_learner": "voting", "top_k": 3,
+                     "verbosity": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y), 3)
+    rank = jax.process_index()
+    with open(os.path.join(outdir, f"vote_{rank}.txt"), "w") as f:
+        f.write(bst.model_to_string())
+""")
+
+
+@pytest.mark.slow
+def test_four_process_voting_parallel(tmp_path):
+    """PV-Tree voting across 4 REAL processes (1 device each): every
+    rank must elect/merge identically and emit the same model."""
+    from lightgbm_tpu.launch import launch
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "vw.py"
+    script.write_text(_VOTING_WORKER)
+    env_clean = {k: v for k, v in os.environ.items()
+                 if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    old = dict(os.environ)
+    os.environ.clear()
+    os.environ.update(env_clean)
+    try:
+        rc = launch([str(script), str(tmp_path), repo], num_processes=4)
+    finally:
+        os.environ.clear()
+        os.environ.update(old)
+    assert rc == 0
+    models = [(tmp_path / f"vote_{r}.txt").read_text() for r in range(4)]
+    assert all(m == models[0] for m in models[1:])
