@@ -478,25 +478,70 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                           | (above & _m_neg[None, None]))
             t_io = jnp.arange(B, dtype=jnp.int32)
             cat_q = is_cat_pf[None, None, :, None]
-            l_ok = (box_lo[None, :, :, None] <= t_io) | cat_q
-            r_ok = (box_hi[None, :, :, None] >= t_io + 1) | cat_q
 
-            def reduce_bounds(mask_d, red, init):
-                cnt = mask_d.sum(axis=2)                    # [S, V]
+            # The naive lattice is [S, V, F, B] (V = L+1): at 255
+            # leaves x 128 features x 255 bins that is ~470M bools per
+            # temporary. The V axis is purely a reduction, so it is
+            # processed in chunks of Vc leaves with min/max carried
+            # across chunks — peak memory S*Vc*F*B, identical results.
+            V = L + 1
+            Vc = max(1, min(V, (1 << 23) // max(1, S * F * B)))
+            nch = (V + Vc - 1) // Vc
+            Vp = nch * Vc
+            pad = Vp - V
+
+            def padV(a, fill):
+                cfg = [(0, 0)] * a.ndim
+                cfg[1] = (0, pad)
+                return jnp.pad(a, cfg, constant_values=fill)
+
+            # padded leaves carry no constraint (mask False)
+            hi_dp = padV(hi_d, False)
+            lo_dp = padV(lo_d, False)
+            box_lo_p = jnp.pad(box_lo, ((0, pad), (0, 0)))
+            box_hi_p = jnp.pad(box_hi, ((0, pad), (0, 0)))
+            v_out_p = jnp.pad(v_out, (0, pad))
+
+            def reduce_bounds(mask_d, kind, init):
+                red_ax = jnp.min if kind == "min" else jnp.max
+                red_el = jnp.minimum if kind == "min" else jnp.maximum
+                cnt = mask_d.sum(axis=2)                    # [S, Vp]
                 any_ex = ((cnt[:, :, None]
-                           - mask_d.astype(cnt.dtype)) > 0)  # [S, V, F]
-                m_l = mask_d[:, :, :, None] | (any_ex[:, :, :, None]
-                                               & l_ok)
-                m_r = mask_d[:, :, :, None] | (any_ex[:, :, :, None]
-                                               & r_ok)
-                vals = v_out[None, :, None, None]
-                b_l = red(jnp.where(m_l, vals, init), axis=1)
-                b_r = red(jnp.where(m_r, vals, init), axis=1)
-                b_s = red(jnp.where(mask_d.any(axis=2),
-                                    v_out[None, :], init), axis=1)
-                return b_l, b_r, b_s
-            hi_l, hi_r, hi_s = reduce_bounds(hi_d, jnp.min, F32_MAX)
-            lo_l, lo_r, lo_s = reduce_bounds(lo_d, jnp.max, -F32_MAX)
+                           - mask_d.astype(cnt.dtype)) > 0)  # [S, Vp, F]
+
+                def chunk(i, acc):
+                    b_l0, b_r0, b_s0 = acc
+                    md = jax.lax.dynamic_slice(
+                        mask_d, (0, i * Vc, 0), (S, Vc, F))
+                    ae = jax.lax.dynamic_slice(
+                        any_ex, (0, i * Vc, 0), (S, Vc, F))
+                    blo = jax.lax.dynamic_slice(
+                        box_lo_p, (i * Vc, 0), (Vc, F))
+                    bhi = jax.lax.dynamic_slice(
+                        box_hi_p, (i * Vc, 0), (Vc, F))
+                    vo = jax.lax.dynamic_slice(v_out_p, (i * Vc,), (Vc,))
+                    l_ok = (blo[None, :, :, None] <= t_io) | cat_q
+                    r_ok = (bhi[None, :, :, None] >= t_io + 1) | cat_q
+                    m_l = md[:, :, :, None] | (ae[:, :, :, None] & l_ok)
+                    m_r = md[:, :, :, None] | (ae[:, :, :, None] & r_ok)
+                    vals = vo[None, :, None, None]
+                    return (red_el(b_l0,
+                                   red_ax(jnp.where(m_l, vals, init),
+                                          axis=1)),
+                            red_el(b_r0,
+                                   red_ax(jnp.where(m_r, vals, init),
+                                          axis=1)),
+                            red_el(b_s0,
+                                   red_ax(jnp.where(md.any(axis=2),
+                                                    vo[None, :], init),
+                                          axis=1)))
+
+                init_l = jnp.full((S, F, B), init, f32)
+                init_s = jnp.full((S,), init, f32)
+                return jax.lax.fori_loop(
+                    0, nch, chunk, (init_l, init_l, init_s))
+            hi_l, hi_r, hi_s = reduce_bounds(hi_dp, "min", F32_MAX)
+            lo_l, lo_r, lo_s = reduce_bounds(lo_dp, "max", -F32_MAX)
             return (lo_l, hi_l, lo_r, hi_r), lo_s, hi_s
 
     def best_for(hist2w, slot_depth, slot_valid, slots_c, t, state, key,

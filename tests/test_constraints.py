@@ -248,3 +248,27 @@ def test_monotone_intermediate_deep_geometry(rng):
                      "monotone_constraints_method": "intermediate"},
                     lgb.Dataset(X, label=y), 30)
     assert _is_monotone(bst, X, 0, increasing=True, grid=60)
+
+
+@pytest.mark.slow
+def test_advanced_mode_scales_to_255_leaves_128_features(rng):
+    """The advanced-mode bound lattice is [S, L+1, F, B]-shaped; it must
+    be chunked, not materialized — a 255-leaf x 128-feature train has to
+    complete on a small host (VERDICT r3 #7)."""
+    n, F = 8_000, 128
+    X = rng.normal(size=(n, F)).astype(np.float32)
+    y = (X[:, 0] + 0.2 * X[:, 1] - 0.1 * X[:, 2]
+         + 0.05 * rng.normal(size=n))
+    mono = [1] + [0] * (F - 1)
+    bst = lgb.train({"objective": "regression", "num_leaves": 255,
+                     "verbosity": -1, "min_data_in_leaf": 10,
+                     "monotone_constraints": mono,
+                     "monotone_constraints_method": "advanced"},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 1)
+    t = bst._all_trees()[0]
+    assert t.num_leaves > 100
+    # the constraint held: predictions nondecreasing along feature 0
+    base = np.zeros((64, F), np.float32)
+    base[:, 0] = np.linspace(-3, 3, 64)
+    p = bst.predict(base)
+    assert (np.diff(p) >= -1e-6).all()
