@@ -72,7 +72,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.histogram import build_histograms, HIST_CH
+from ..ops.histogram import build_histograms, resolve_impl, HIST_CH
 from ..ops.predict import row_feature_gather
 from ..ops.split import (SplitParams, find_best_splits, leaf_gain,
                          leaf_output)
@@ -115,6 +115,15 @@ def _round_int(x):
     return jnp.floor(x + 0.5)
 
 
+def build_tree(*args, hist_impl: str = "auto", **kwargs):
+    """Unjitted entry: resolves ``hist_impl='auto'`` EAGERLY (the Pallas
+    probe must compile outside any trace — staged into an ambient trace
+    its try/except would pass vacuously) and dispatches to the jitted
+    core. Same contract as :func:`_build_tree_jit` below."""
+    return _build_tree_jit(*args, hist_impl=resolve_impl(hist_impl),
+                           **kwargs)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
@@ -122,7 +131,7 @@ def _round_int(x):
                      "block_rows", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins", "mono_method",
                      "forced", "hist_sub"))
-def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
+def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
                *, num_leaves: int, leaf_batch: int, max_depth: int,
@@ -171,6 +180,19 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
       are gathered and psum'd (communication O(top_k·B), not O(F·B));
       the split is chosen from those global sub-histograms.
     """
+    # 'auto' reaching here means a traced caller with no warm probe
+    # cache — resolve_impl then answers conservatively (no mid-trace
+    # probe); the eager wrapper above handles direct callers
+    hist_impl = resolve_impl(hist_impl)
+    # Row compaction redirects the bins stream through a gathered index
+    # order. That pays off exactly when the kernel's row stream is
+    # expensive relative to one [R, F] pass: the matmul one-hot
+    # (R*F*B bf16) and the CPU scatter. The Pallas kernel already
+    # streams only R*F bins, so a full-R gather per round would COST a
+    # pass instead of saving one — subtraction still applies (cache +
+    # parent-minus-child are stream-free), only the compaction is
+    # skipped there.
+    hist_compact = hist_sub and hist_impl != "pallas"
     R = bins.shape[0]
     F = num_bins_pf.shape[0]   # per-FEATURE count (bins may be bundled)
     L = num_leaves
@@ -1135,17 +1157,25 @@ def build_tree(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             small_is_left = l_raw <= r_raw
             small_slots = jnp.where(
                 valid, jnp.where(small_is_left, sel_s, right_slot), -2)
-            m = (row_leaf[:, None] == small_slots[None, :]).any(axis=1)
-            pos = jnp.cumsum(m.astype(jnp.int32)) - 1
-            n_small = m.astype(jnp.int32).sum()
-            c_idx = jnp.zeros((R,), jnp.int32).at[
-                jnp.where(m, pos, R)].set(
-                jnp.arange(R, dtype=jnp.int32), mode="drop")
-            rl_c = jnp.where(jnp.arange(R, dtype=jnp.int32) < n_small,
-                             jnp.take(row_leaf, c_idx), -1)
-            gh_c = jnp.take(gh, c_idx, axis=0)
-            hsmall = hist_raw_for(small_slots, rl_c, gh_in=gh_c,
-                                  row_gather=c_idx, num_rows=n_small)
+            if hist_compact:
+                m = (row_leaf[:, None] == small_slots[None, :]).any(
+                    axis=1)
+                pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+                n_small = m.astype(jnp.int32).sum()
+                c_idx = jnp.zeros((R,), jnp.int32).at[
+                    jnp.where(m, pos, R)].set(
+                    jnp.arange(R, dtype=jnp.int32), mode="drop")
+                rl_c = jnp.where(
+                    jnp.arange(R, dtype=jnp.int32) < n_small,
+                    jnp.take(row_leaf, c_idx), -1)
+                gh_c = jnp.take(gh, c_idx, axis=0)
+                hsmall = hist_raw_for(small_slots, rl_c, gh_in=gh_c,
+                                      row_gather=c_idx,
+                                      num_rows=n_small)
+            else:
+                # full masked stream (Pallas): rows outside the small
+                # slots simply match no leaf id
+                hsmall = hist_raw_for(small_slots, row_leaf)
             parent_raw = jnp.take(st["hist_cache"],
                                   jnp.clip(sel_s, 0, L), axis=0)
             hbig = parent_raw - hsmall

@@ -110,12 +110,23 @@ def _probe_pallas_training() -> bool:
     return _PALLAS_TRAIN_OK
 
 
+def _trace_state_clean() -> bool:
+    try:
+        return jax.core.trace_state_clean()
+    except Exception:
+        return True
+
+
 def resolve_impl(impl: str) -> str:
     """Resolve ``hist_impl='auto'`` to a concrete kernel for this backend.
 
     Call eagerly (GBDT setup does) before any tracing: on TPU the Pallas
     kernel is the default but only after a one-time probe compile proves
-    Mosaic accepts it — otherwise the matmul formulation.
+    Mosaic accepts it — otherwise the matmul formulation. When invoked
+    mid-trace with the probe not yet run (a direct jitted caller), the
+    probe CANNOT run meaningfully — its ops would be staged into the
+    ambient trace and the try/except would pass vacuously, poisoning the
+    cache — so resolution stays conservatively on matmul instead.
     """
     if impl != "auto":
         return impl
@@ -123,6 +134,8 @@ def resolve_impl(impl: str) -> str:
     if backend == "cpu":
         return "scatter"     # XLA lowers the scatter to per-row adds
     if backend == "tpu":
+        if _PALLAS_TRAIN_OK is None and not _trace_state_clean():
+            return "matmul"
         return "pallas" if _probe_pallas_training() else "matmul"
     return "matmul"
 
