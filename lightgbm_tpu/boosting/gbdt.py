@@ -242,6 +242,13 @@ class GBDT:
                     and getattr(self.train_set, "position", None) is not None):
                 okw["position"] = self.train_set.position
             objective.init(lbl, w, self.train_set.query_boundaries(), **okw)
+            if objective.label is not lbl:
+                # init() may retarget training to a transformed label
+                # space (reg_sqrt trains on sign(y)*sqrt(|y|),
+                # regression_objective.hpp sqrt_); gradients must see
+                # the SAME label the init score was derived from
+                self.label_dev = _row_put(_pad_rows(
+                    np.asarray(objective.label, np.float32), R_loc))
             self._init_scores = np.asarray(objective.boost_from_score(),
                                            dtype=np.float64).reshape(-1)
             if len(self._init_scores) != self.K:

@@ -613,10 +613,20 @@ class Booster:
         name = self._objective_name
         if name == "binary":
             return f"binary sigmoid:{Config(self.params).sigmoid:g}"
-        if name in ("multiclass", "multiclassova"):
-            return f"{name} num_class:{self._num_class}"
+        if name == "multiclass":
+            return f"multiclass num_class:{self._num_class}"
+        if name == "multiclassova":
+            # MulticlassOVA::ToString also records the per-class sigmoid
+            # (multiclass_objective.hpp:249)
+            return (f"multiclassova num_class:{self._num_class} "
+                    f"sigmoid:{Config(self.params).sigmoid:g}")
         if name == "lambdarank":
             return "lambdarank"
+        if name == "regression" and Config(self.params).reg_sqrt:
+            # RegressionL2loss::ToString appends " sqrt"
+            # (regression_objective.hpp:160); dropping it loses the
+            # output square transform on reload
+            return "regression sqrt"
         return name
 
     def _feature_infos_list(self) -> List[str]:
@@ -657,6 +667,20 @@ class Booster:
         self._feature_names = header.get("feature_names", "").split()
         self._feature_infos = header.get("feature_infos", "").split()
         self.params.setdefault("objective", self._objective_name)
+        # objective SUFFIX tokens carry transform state the reloaded
+        # predictor needs (ObjectiveFunction::ToString grammar):
+        # "sigmoid:2" / "sqrt" / "tweedie_variance_power:p"
+        for tok in obj[1:]:
+            if tok == "sqrt":
+                self.params.setdefault("reg_sqrt", True)
+            elif ":" in tok:
+                k, v = tok.split(":", 1)
+                if k in ("sigmoid", "tweedie_variance_power", "alpha",
+                         "fair_c", "poisson_max_delta_step"):
+                    try:
+                        self.params.setdefault(k, float(v))
+                    except ValueError:
+                        pass
         if self._num_class > 1:
             self.params["num_class"] = self._num_class
         self.config = Config({k: v for k, v in self.params.items()})

@@ -20,13 +20,38 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["native_lib", "parse_delimited", "parse_libsvm"]
+__all__ = ["native_lib", "capi_lib", "parse_delimited", "parse_libsvm"]
 
 _LIB = None
 _TRIED = False
+_CAPI = None
+_CAPI_TRIED = False
 
 _DOUBLE_P = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 
+
+
+
+def _compile_and_load(src_name: str, so_prefix: str, extra_gcc=()):
+    """gcc-compile a bundled C source into the content-hashed per-user
+    cache (0700 — a predictable /tmp path would let another local user
+    pre-plant a malicious .so) and ctypes-load it. Raises on failure."""
+    src = os.path.join(os.path.dirname(__file__), src_name)
+    with open(src, "rb") as f:
+        code = f.read()
+    tag = hashlib.sha256(code).hexdigest()[:16]
+    cache_dir = os.environ.get("LIGHTGBM_TPU_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "lightgbm_tpu")
+    os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+    so = os.path.join(cache_dir, f"{so_prefix}_{tag}.so")
+    if not os.path.exists(so):
+        tmp = f"{so}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["gcc", "-O3", "-shared", "-fPIC", "-o", tmp, src,
+             *extra_gcc],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)  # atomic: concurrent builders both win
+    return ctypes.CDLL(so)
 
 def native_lib():
     """The loaded CDLL, or None when native helpers are unavailable."""
@@ -36,24 +61,8 @@ def native_lib():
     _TRIED = True
     if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
         return None
-    src = os.path.join(os.path.dirname(__file__), "parser.c")
     try:
-        with open(src, "rb") as f:
-            code = f.read()
-        tag = hashlib.sha256(code).hexdigest()[:16]
-        # per-user 0700 cache: a predictable path in world-writable /tmp
-        # would let another local user pre-plant a malicious .so
-        cache_dir = os.environ.get("LIGHTGBM_TPU_CACHE") or os.path.join(
-            os.path.expanduser("~"), ".cache", "lightgbm_tpu")
-        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
-        so = os.path.join(cache_dir, f"lightgbm_tpu_parser_{tag}.so")
-        if not os.path.exists(so):
-            tmp = f"{so}.{os.getpid()}.tmp"
-            subprocess.run(
-                ["gcc", "-O3", "-shared", "-fPIC", "-o", tmp, src],
-                check=True, capture_output=True, timeout=120)
-            os.replace(tmp, so)  # atomic: concurrent builders both win
-        lib = ctypes.CDLL(so)
+        lib = _compile_and_load("parser.c", "lightgbm_tpu_parser")
         lib.lgbtpu_max_cols.restype = ctypes.c_long
         lib.lgbtpu_max_cols.argtypes = [ctypes.c_char_p, ctypes.c_long,
                                         ctypes.c_char]
@@ -72,6 +81,42 @@ def native_lib():
     except Exception:
         _LIB = None
     return _LIB
+
+
+def capi_lib():
+    """The native C inference API (capi.c), runtime-compiled and loaded
+    via ctypes like :func:`native_lib`. Returns None when unavailable.
+    C consumers build the .so directly (see capi.h); this loader exists
+    for the test suite and for Python-side smoke use."""
+    global _CAPI, _CAPI_TRIED
+    if _CAPI_TRIED:
+        return _CAPI
+    _CAPI_TRIED = True
+    if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+        return None
+    try:
+        lib = _compile_and_load("capi.c", "lightgbm_tpu_capi",
+                                extra_gcc=("-lm",))
+        lib.LGBM_GetLastError.restype = ctypes.c_char_p
+        lib.LGBM_BoosterCreateFromModelfile.restype = ctypes.c_int
+        lib.LGBM_BoosterCreateFromModelfile.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.LGBM_BoosterFree.argtypes = [ctypes.c_void_p]
+        lib.LGBM_BoosterGetNumClasses.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+        lib.LGBM_BoosterGetNumFeature.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int)]
+        lib.LGBM_BoosterPredictForMat.restype = ctypes.c_int
+        lib.LGBM_BoosterPredictForMat.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), _DOUBLE_P]
+        _CAPI = lib
+    except Exception:
+        _CAPI = None
+    return _CAPI
 
 
 def parse_delimited(lines, delim: str) -> Optional[np.ndarray]:

@@ -1,0 +1,525 @@
+/* Native C inference API — the deployment subset of the reference's
+ * C ABI (reference: src/c_api.cpp LGBM_BoosterCreateFromModelfile /
+ * LGBM_BoosterPredictForMat, include/LightGBM/c_api.h).
+ *
+ * Pure C, zero dependencies: parses the LightGBM v4 model TEXT format
+ * (the durable ABI this project standardizes on — README "Scope") and
+ * walks the ensemble with the exact decision semantics of the
+ * reference's Tree::NumericalDecision / CategoricalDecision
+ * (include/LightGBM/tree.h:345-399): NaN folds to 0.0 unless
+ * missing_type==NaN, MissingType::Zero treats |v| <= 1e-35 as missing,
+ * categorical NaN/negative route right, bitset membership via the
+ * cat_boundaries/cat_threshold words.
+ *
+ * Scope: model load + predict (normal / raw / leaf index) for
+ * regression, binary (sigmoid), multiclass (softmax), multiclassova
+ * (per-class sigmoid), ranking; average_output (random forest) honored.
+ * Training from C is NOT provided — train in Python, deploy from C (or
+ * use codegen.py for fully compiled models).
+ *
+ * Build: gcc -O3 -shared -fPIC -o liblightgbm_tpu_capi.so capi.c -lm
+ */
+
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define LGBM_API_OK 0
+#define LGBM_API_ERR (-1)
+
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+
+#define C_API_PREDICT_NORMAL (0)
+#define C_API_PREDICT_RAW_SCORE (1)
+#define C_API_PREDICT_LEAF_INDEX (2)
+
+static __thread char g_err[512] = "ok";
+
+static int set_err(const char *msg) {
+    snprintf(g_err, sizeof(g_err), "%s", msg);
+    return LGBM_API_ERR;
+}
+
+const char *LGBM_GetLastError(void) { return g_err; }
+
+/* ---------------- model structures ---------------- */
+
+typedef struct {
+    int num_leaves;
+    int num_cat;
+    int *split_feature;   /* [num_leaves-1] */
+    double *threshold;
+    int *decision_type;
+    int *left_child;
+    int *right_child;
+    double *leaf_value;   /* [num_leaves] */
+    int *cat_boundaries;  /* [num_cat+1] or NULL */
+    uint32_t *cat_threshold;
+    int n_cat_words;
+    int is_linear;
+} CTree;
+
+typedef struct {
+    int num_class;        /* classes in the MODEL output */
+    int num_tpi;          /* num_tree_per_iteration */
+    int max_feature_idx;
+    int num_trees;
+    int average_output;
+    int obj;              /* 0 identity, 1 sigmoid, 2 softmax, 3 ova */
+    double sigmoid;
+    CTree *trees;
+} CBooster;
+
+static void free_tree(CTree *t) {
+    free(t->split_feature); free(t->threshold); free(t->decision_type);
+    free(t->left_child); free(t->right_child); free(t->leaf_value);
+    free(t->cat_boundaries); free(t->cat_threshold);
+}
+
+/* ---------------- text parsing ---------------- */
+
+/* value string of "key=..." if the line matches, else NULL */
+static const char *kv(const char *line, const char *key) {
+    size_t k = strlen(key);
+    if (strncmp(line, key, k) == 0 && line[k] == '=') return line + k + 1;
+    return NULL;
+}
+
+static int count_tokens(const char *s) {
+    int n = 0;
+    while (*s) {
+        while (*s == ' ') s++;
+        if (*s && *s != '\n') { n++; while (*s && *s != ' ' && *s != '\n') s++; }
+    }
+    return n;
+}
+
+static int *parse_ints(const char *s, int expect) {
+    int n = count_tokens(s);
+    if (n != expect) return NULL;
+    int *out = (int *)malloc(sizeof(int) * (n > 0 ? n : 1));
+    if (!out) return NULL;
+    const char *p = s;
+    for (int i = 0; i < n; i++) {
+        char *e;
+        out[i] = (int)strtol(p, &e, 10);
+        if (e == p) { free(out); return NULL; }
+        p = e;
+    }
+    return out;
+}
+
+static uint32_t *parse_u32s(const char *s, int expect) {
+    int n = count_tokens(s);
+    if (n != expect) return NULL;
+    uint32_t *out = (uint32_t *)malloc(sizeof(uint32_t) * (n > 0 ? n : 1));
+    if (!out) return NULL;
+    const char *p = s;
+    for (int i = 0; i < n; i++) {
+        char *e;
+        out[i] = (uint32_t)strtoul(p, &e, 10);
+        if (e == p) { free(out); return NULL; }
+        p = e;
+    }
+    return out;
+}
+
+static double *parse_doubles(const char *s, int expect) {
+    int n = count_tokens(s);
+    if (n != expect) return NULL;
+    double *out = (double *)malloc(sizeof(double) * (n > 0 ? n : 1));
+    if (!out) return NULL;
+    const char *p = s;
+    for (int i = 0; i < n; i++) {
+        char *e;
+        out[i] = strtod(p, &e);
+        if (e == p) { free(out); return NULL; }
+        p = e;
+    }
+    return out;
+}
+
+/* next line start; *len excludes the line terminator, *adv is the
+ * full distance to the next line (so CRLF strips don't desync) */
+static const char *next_line(const char *p, const char *end, size_t *len,
+                             size_t *adv) {
+    if (p >= end) return NULL;
+    const char *nl = memchr(p, '\n', (size_t)(end - p));
+    size_t n = nl ? (size_t)(nl - p) : (size_t)(end - p);
+    *adv = nl ? n + 1 : n;
+    if (n > 0 && p[n - 1] == '\r') n--;      /* CRLF model files */
+    *len = n;
+    return p;
+}
+
+/* free-old-then-assign: duplicate keys in a malformed block must not
+ * leak the first allocation */
+#define SET_ARR(field, expr) do { free(t->field); t->field = (expr); } \
+    while (0)
+
+static int parse_tree(const char **pp, const char *end, CTree *t) {
+    memset(t, 0, sizeof(*t));
+    t->num_leaves = -1;
+    t->num_cat = 0;
+    const char *p = *pp;
+    size_t len, adv;
+    char *line = NULL;
+    size_t line_cap = 0;
+    while ((p = next_line(p, end, &len, &adv)) != NULL) {
+        const char *cur = p;
+        p += adv;
+        if (len == 0) break;                     /* blank ends the block */
+        if (len + 1 > line_cap) {                /* lines can be ~MBs
+                                                    (leaf_value of wide
+                                                    trees) */
+            free(line);
+            line_cap = len + 1;
+            line = (char *)malloc(line_cap);
+            if (!line) { *pp = p; free_tree(t); return set_err("oom"); }
+        }
+        memcpy(line, cur, len);
+        line[len] = 0;
+        const char *v;
+        int ni = t->num_leaves > 1 ? t->num_leaves - 1 : 0;
+        if ((v = kv(line, "num_leaves"))) t->num_leaves = atoi(v);
+        else if ((v = kv(line, "num_cat"))) t->num_cat = atoi(v);
+        else if ((v = kv(line, "split_feature")))
+            SET_ARR(split_feature, parse_ints(v, ni));
+        else if ((v = kv(line, "threshold")))
+            SET_ARR(threshold, parse_doubles(v, ni));
+        else if ((v = kv(line, "decision_type")))
+            SET_ARR(decision_type, parse_ints(v, ni));
+        else if ((v = kv(line, "left_child")))
+            SET_ARR(left_child, parse_ints(v, ni));
+        else if ((v = kv(line, "right_child")))
+            SET_ARR(right_child, parse_ints(v, ni));
+        else if ((v = kv(line, "leaf_value")))
+            SET_ARR(leaf_value, parse_doubles(
+                v, t->num_leaves > 0 ? t->num_leaves : 1));
+        else if ((v = kv(line, "cat_boundaries")))
+            SET_ARR(cat_boundaries, parse_ints(v, t->num_cat + 1));
+        else if ((v = kv(line, "cat_threshold"))) {
+            t->n_cat_words = count_tokens(v);
+            SET_ARR(cat_threshold, parse_u32s(v, t->n_cat_words));
+        } else if ((v = kv(line, "is_linear")))
+            t->is_linear = atoi(v);
+        /* leaf_weight/count, internal_*, split_gain, is_linear,
+         * shrinkage: not needed for prediction */
+    }
+    free(line);
+    *pp = p ? p : end;
+    int bad = (t->num_leaves < 1 || !t->leaf_value) ||
+              (t->num_leaves > 1 &&
+               (!t->split_feature || !t->threshold ||
+                !t->decision_type || !t->left_child ||
+                !t->right_child)) ||
+              (t->num_cat > 0 && (!t->cat_boundaries ||
+                                  !t->cat_threshold));
+    if (bad) {
+        free_tree(t);
+        memset(t, 0, sizeof(*t));
+        return set_err("tree block missing or malformed arrays");
+    }
+    return LGBM_API_OK;
+}
+
+/* bounds-check every file-derived index BEFORE the predict walk ever
+ * dereferences it: corrupt/hand-edited models must fail the load, not
+ * read out of bounds in a serving process */
+static int validate_tree(const CTree *t, int max_feature_idx) {
+    if (t->is_linear)
+        return set_err("linear-tree models are not supported by the C "
+                       "inference API (predict them in Python or via "
+                       "codegen)");
+    int ni = t->num_leaves - 1;
+    for (int i = 0; i < ni; i++) {
+        if (t->split_feature[i] < 0 ||
+            t->split_feature[i] > max_feature_idx)
+            return set_err("split_feature out of range");
+        int lc = t->left_child[i], rc = t->right_child[i];
+        if ((lc >= 0 && lc >= ni) || (lc < 0 && ~lc >= t->num_leaves) ||
+            (rc >= 0 && rc >= ni) || (rc < 0 && ~rc >= t->num_leaves))
+            return set_err("child index out of range");
+        if (t->decision_type[i] & 1) {
+            int ci = (int)t->threshold[i];
+            if (ci < 0 || ci >= t->num_cat)
+                return set_err("categorical threshold out of range");
+        }
+    }
+    for (int c = 0; c < t->num_cat; c++) {
+        if (t->cat_boundaries[c] < 0 ||
+            t->cat_boundaries[c + 1] < t->cat_boundaries[c] ||
+            t->cat_boundaries[c + 1] > t->n_cat_words)
+            return set_err("cat_boundaries out of range");
+    }
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char *filename,
+                                    int *out_num_iterations,
+                                    void **out) {
+    *out = NULL;
+    FILE *f = fopen(filename, "rb");
+    if (!f) return set_err("cannot open model file");
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    if (sz < 0) { fclose(f); return set_err("unseekable model file"); }
+    fseek(f, 0, SEEK_SET);
+    char *buf = (char *)malloc((size_t)sz + 1);
+    if (!buf) { fclose(f); return set_err("oom"); }
+    if (fread(buf, 1, (size_t)sz, f) != (size_t)sz) {
+        free(buf); fclose(f); return set_err("short read");
+    }
+    fclose(f);
+    buf[sz] = 0;
+    const char *end = buf + sz;
+    /* stop at the trailer: everything after "end of trees" is
+     * feature importances / parameters */
+    const char *eot = strstr(buf, "\nend of trees");
+    if (eot) end = eot;
+
+    CBooster *b = (CBooster *)calloc(1, sizeof(CBooster));
+    if (!b) { free(buf); return set_err("oom"); }
+    b->num_class = 1;
+    b->num_tpi = 1;
+    b->sigmoid = 1.0;
+    int cap = 16;
+    b->trees = (CTree *)malloc(sizeof(CTree) * cap);
+    if (!b->trees) { free(buf); free(b); return set_err("oom"); }
+
+    const char *p = buf;
+    size_t len, adv;
+    char *line = NULL;
+    size_t line_cap = 0;
+    int ok = 1;
+    while (ok && (p = next_line(p, end, &len, &adv)) != NULL) {
+        const char *cur = p;
+        p += adv;
+        if (len == 0) continue;
+        if (len + 1 > line_cap) {
+            free(line);
+            line_cap = len + 1;
+            line = (char *)malloc(line_cap);
+            if (!line) { ok = 0; set_err("oom"); break; }
+        }
+        memcpy(line, cur, len);
+        line[len] = 0;
+        const char *v;
+        if ((v = kv(line, "num_class"))) b->num_class = atoi(v);
+        else if ((v = kv(line, "num_tree_per_iteration")))
+            b->num_tpi = atoi(v);
+        else if ((v = kv(line, "max_feature_idx")))
+            b->max_feature_idx = atoi(v);
+        else if (strcmp(line, "average_output") == 0)
+            b->average_output = 1;
+        else if ((v = kv(line, "objective"))) {
+            if (strncmp(v, "binary", 6) == 0) {
+                b->obj = 1;
+                const char *s = strstr(v, "sigmoid:");
+                if (s) b->sigmoid = atof(s + 8);
+            } else if (strncmp(v, "cross_entropy_lambda", 20) == 0) {
+                b->obj = 5;             /* 1 - exp(-exp(raw)) */
+            } else if (strncmp(v, "multiclassova", 13) == 0 ||
+                       strncmp(v, "cross_entropy", 13) == 0) {
+                b->obj = (strncmp(v, "multiclassova", 13) == 0) ? 3 : 1;
+                const char *s = strstr(v, "sigmoid:");
+                if (s) b->sigmoid = atof(s + 8);
+                if (b->obj == 1 && !s) b->sigmoid = 1.0;
+            } else if (strncmp(v, "multiclass", 10) == 0) {
+                b->obj = 2;
+            } else if (strncmp(v, "custom", 6) == 0 ||
+                       strncmp(v, "none", 4) == 0) {
+                b->obj = 0;
+            }
+            /* regression family / ranking: raw scores (obj 0); the
+             * exp-family objectives (poisson/gamma/tweedie) transform
+             * with exp; "regression sqrt" squares with sign
+             * (regression_objective.hpp:160 ToString suffix) */
+            else if (strncmp(v, "poisson", 7) == 0 ||
+                     strncmp(v, "gamma", 5) == 0 ||
+                     strncmp(v, "tweedie", 7) == 0)
+                b->obj = 4;
+            else if (strncmp(v, "regression", 10) == 0 &&
+                     strstr(v, " sqrt"))
+                b->obj = 6; /* sign(x) * x^2 */
+        } else if (kv(line, "Tree")) {
+            if (b->num_trees == cap) {
+                cap *= 2;
+                CTree *nt = (CTree *)realloc(b->trees,
+                                             sizeof(CTree) * cap);
+                if (!nt) { ok = 0; set_err("oom"); break; }
+                b->trees = nt;
+            }
+            if (parse_tree(&p, end, &b->trees[b->num_trees]) !=
+                LGBM_API_OK) { ok = 0; break; }
+            b->num_trees++;
+            if (validate_tree(&b->trees[b->num_trees - 1],
+                              b->max_feature_idx) != LGBM_API_OK) {
+                ok = 0; break;
+            }
+        }
+    }
+    free(line);
+    free(buf);
+    if (!ok || b->num_trees == 0) {
+        if (ok) set_err("model file holds no trees");
+        for (int i = 0; i < b->num_trees; i++) free_tree(&b->trees[i]);
+        free(b->trees); free(b);
+        return LGBM_API_ERR;
+    }
+    *out_num_iterations = b->num_trees / (b->num_tpi > 0 ? b->num_tpi : 1);
+    *out = b;
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterFree(void *handle) {
+    CBooster *b = (CBooster *)handle;
+    if (!b) return LGBM_API_OK;
+    for (int i = 0; i < b->num_trees; i++) free_tree(&b->trees[i]);
+    free(b->trees);
+    free(b);
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterGetNumClasses(void *handle, int *out_len) {
+    CBooster *b = (CBooster *)handle;
+    if (!b) return set_err("null handle");
+    *out_len = b->num_class;
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterGetNumFeature(void *handle, int *out_len) {
+    CBooster *b = (CBooster *)handle;
+    if (!b) return set_err("null handle");
+    *out_len = b->max_feature_idx + 1;
+    return LGBM_API_OK;
+}
+
+/* tree.h:345 NumericalDecision + :383 CategoricalDecision, exactly */
+static int tree_leaf(const CTree *t, const double *row) {
+    int node = 0;
+    if (t->num_leaves == 1) return 0;
+    for (;;) {
+        int dt = t->decision_type[node];
+        double v = row[t->split_feature[node]];
+        int next;
+        if (dt & 1) {                                   /* categorical */
+            int go_right = 0;
+            if (isnan(v)) go_right = 1;
+            else {
+                int iv = (int)v;
+                if (iv < 0) go_right = 1;
+                else {
+                    int ci = (int)t->threshold[node];
+                    int lo = t->cat_boundaries[ci];
+                    int n_words = t->cat_boundaries[ci + 1] - lo;
+                    if (iv >= n_words * 32 ||
+                        !((t->cat_threshold[lo + (iv >> 5)] >>
+                           (iv & 31)) & 1u))
+                        go_right = 1;
+                }
+            }
+            next = go_right ? t->right_child[node] : t->left_child[node];
+        } else {
+            int mtype = (dt >> 2) & 3;
+            if (isnan(v) && mtype != 2) v = 0.0;
+            int missing = (mtype == 1 && v >= -1e-35 && v <= 1e-35) ||
+                          (mtype == 2 && isnan(v));
+            if (missing)
+                next = (dt & 2) ? t->left_child[node]
+                                : t->right_child[node];
+            else
+                next = (v <= t->threshold[node]) ? t->left_child[node]
+                                                 : t->right_child[node];
+        }
+        if (next < 0) return ~next;
+        node = next;
+    }
+}
+
+int LGBM_BoosterPredictForMat(void *handle, const void *data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char *parameter, int64_t *out_len,
+                              double *out_result) {
+    (void)parameter;
+    CBooster *b = (CBooster *)handle;
+    if (!b) return set_err("null handle");
+    if (!is_row_major) return set_err("only row-major input supported");
+    if (ncol != b->max_feature_idx + 1)
+        return set_err("wrong number of feature columns");
+    int tpi = b->num_tpi > 0 ? b->num_tpi : 1;
+    int iters = b->num_trees / tpi;
+    if (start_iteration < 0 || start_iteration > iters)
+        return set_err("bad start_iteration");
+    int use_iters = (num_iteration <= 0) ? iters - start_iteration
+                                         : num_iteration;
+    if (start_iteration + use_iters > iters)
+        use_iters = iters - start_iteration;
+    int t0 = start_iteration * tpi, t1 = (start_iteration + use_iters) * tpi;
+
+    double *row = (double *)malloc(sizeof(double) * (size_t)ncol);
+    double *acc = (double *)malloc(sizeof(double) * (size_t)b->num_class);
+    if (!row || !acc) { free(row); free(acc); return set_err("oom"); }
+
+    for (int32_t r = 0; r < nrow; r++) {
+        if (data_type == C_API_DTYPE_FLOAT64) {
+            const double *src = ((const double *)data) + (size_t)r * ncol;
+            memcpy(row, src, sizeof(double) * (size_t)ncol);
+        } else if (data_type == C_API_DTYPE_FLOAT32) {
+            const float *src = ((const float *)data) + (size_t)r * ncol;
+            for (int c = 0; c < ncol; c++) row[c] = (double)src[c];
+        } else {
+            free(row); free(acc);
+            return set_err("data_type must be float32(0)/float64(1)");
+        }
+        if (predict_type == C_API_PREDICT_LEAF_INDEX) {
+            for (int t = t0; t < t1; t++)
+                out_result[(size_t)r * (t1 - t0) + (t - t0)] =
+                    (double)tree_leaf(&b->trees[t], row);
+            continue;
+        }
+        for (int k = 0; k < b->num_class; k++) acc[k] = 0.0;
+        for (int t = t0; t < t1; t++)
+            acc[t % tpi] +=
+                b->trees[t].leaf_value[tree_leaf(&b->trees[t], row)];
+        if (b->average_output && use_iters > 0)
+            for (int k = 0; k < b->num_class; k++) acc[k] /= use_iters;
+        if (predict_type == C_API_PREDICT_NORMAL) {
+            if (b->obj == 1 || b->obj == 3) {
+                for (int k = 0; k < b->num_class; k++)
+                    acc[k] = 1.0 / (1.0 + exp(-b->sigmoid * acc[k]));
+            } else if (b->obj == 2) {
+                double mx = acc[0];
+                for (int k = 1; k < b->num_class; k++)
+                    if (acc[k] > mx) mx = acc[k];
+                double s = 0.0;
+                for (int k = 0; k < b->num_class; k++) {
+                    acc[k] = exp(acc[k] - mx);
+                    s += acc[k];
+                }
+                for (int k = 0; k < b->num_class; k++) acc[k] /= s;
+            } else if (b->obj == 4) {
+                for (int k = 0; k < b->num_class; k++)
+                    acc[k] = exp(acc[k]);
+            } else if (b->obj == 5) {   /* xentlambda */
+                for (int k = 0; k < b->num_class; k++)
+                    acc[k] = 1.0 - exp(-exp(acc[k]));
+            } else if (b->obj == 6) {   /* regression sqrt */
+                for (int k = 0; k < b->num_class; k++)
+                    acc[k] = (acc[k] >= 0 ? 1.0 : -1.0) * acc[k] * acc[k];
+            }
+        }
+        for (int k = 0; k < b->num_class; k++)
+            out_result[(size_t)r * b->num_class + k] = acc[k];
+    }
+    free(row); free(acc);
+    *out_len = (predict_type == C_API_PREDICT_LEAF_INDEX)
+                   ? (int64_t)nrow * (t1 - t0)
+                   : (int64_t)nrow * b->num_class;
+    return LGBM_API_OK;
+}

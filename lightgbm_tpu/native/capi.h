@@ -1,0 +1,48 @@
+/* lightgbm_tpu native C inference API — the deployment subset of the
+ * reference C ABI (include/LightGBM/c_api.h). Load a saved v4 text
+ * model and predict from C with zero dependencies; train in Python.
+ *
+ * Build: gcc -O3 -shared -fPIC -o liblightgbm_tpu_capi.so capi.c -lm
+ */
+#ifndef LIGHTGBM_TPU_CAPI_H_
+#define LIGHTGBM_TPU_CAPI_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define C_API_DTYPE_FLOAT32 (0)
+#define C_API_DTYPE_FLOAT64 (1)
+
+#define C_API_PREDICT_NORMAL (0)     /* transformed scores */
+#define C_API_PREDICT_RAW_SCORE (1)  /* raw margins */
+#define C_API_PREDICT_LEAF_INDEX (2) /* per-tree leaf ids */
+
+/* Returns a static message for the last error on this thread. */
+const char *LGBM_GetLastError(void);
+
+/* Load a v4 text model. 0 on success, -1 on error. */
+int LGBM_BoosterCreateFromModelfile(const char *filename,
+                                    int *out_num_iterations,
+                                    void **out);
+int LGBM_BoosterFree(void *handle);
+int LGBM_BoosterGetNumClasses(void *handle, int *out_len);
+int LGBM_BoosterGetNumFeature(void *handle, int *out_len);
+
+/* Predict for a dense row-major matrix. `data` is float32 or float64
+ * per `data_type`; `out_result` must hold nrow*num_class doubles
+ * (nrow*num_used_trees for leaf index). `parameter` is accepted for
+ * signature compatibility and ignored. */
+int LGBM_BoosterPredictForMat(void *handle, const void *data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char *parameter, int64_t *out_len,
+                              double *out_result);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* LIGHTGBM_TPU_CAPI_H_ */

@@ -80,6 +80,9 @@ class RegressionL2(Objective):
     def __init__(self, cfg):
         super().__init__(cfg)
         self.sqrt = bool(cfg.reg_sqrt)
+        # sqrt mode trains in sqrt-space; predictions must square back
+        # (RegressionL2loss::ConvertOutput, regression_objective.hpp)
+        self.needs_convert = self.sqrt
 
     def init(self, label, weight, query_boundaries=None):
         if self.sqrt:

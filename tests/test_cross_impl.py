@@ -139,3 +139,16 @@ def test_reference_loads_our_lambdarank_model(rng, tmp_path):
                     lgb.Dataset(X, label=rel, group=np.full(nq, per),
                                 free_raw_data=False), 8)
     _roundtrip(bst, X, rel, tmp_path, "lr")
+
+
+def test_reference_loads_our_reg_sqrt_model(rng, tmp_path):
+    """reg_sqrt: the model text carries the "regression sqrt" objective
+    suffix (regression_objective.hpp:160) and the reference applies the
+    sign(x)*x^2 output transform — predictions must match ours."""
+    X = rng.normal(size=(2000, 4)).round(4)
+    y = np.abs(X[:, 0]) * 2 + 0.1
+    bst = lgb.train({"objective": "regression", "reg_sqrt": True,
+                     "num_leaves": 15, "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 8)
+    assert "regression sqrt" in bst.model_to_string()
+    _roundtrip(bst, X, y, tmp_path, "regsqrt", atol=1e-7)

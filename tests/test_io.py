@@ -159,3 +159,27 @@ def test_dataset_subset(rng):
     bst = lgb.train({"objective": "regression", "verbosity": -1,
                      "num_leaves": 7}, sub, 5)
     assert np.isfinite(bst.predict(X[:10])).all()
+
+
+def test_objective_suffix_roundtrip(rng, tmp_path):
+    """Model text objective suffixes (sigmoid:k, sqrt) must survive
+    save->load: they carry the output transform
+    (regression_objective.hpp:160 ToString)."""
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(1200, 4))
+    cases = [
+        ({"objective": "binary", "sigmoid": 2.5},
+         (X[:, 0] > 0).astype(float)),
+        ({"objective": "regression", "reg_sqrt": True},
+         np.abs(X[:, 0]) * 2 + 0.1),
+    ]
+    for params, y in cases:
+        bst = lgb.train(dict(params, num_leaves=7, verbosity=-1),
+                        lgb.Dataset(X, label=y, free_raw_data=False), 4)
+        p = tmp_path / "m.txt"
+        bst.save_model(str(p))
+        b2 = lgb.Booster(model_file=str(p))
+        np.testing.assert_allclose(b2.predict(X[:200]),
+                                   bst.predict(X[:200]),
+                                   rtol=1e-6, atol=1e-7,
+                                   err_msg=str(params))
