@@ -91,13 +91,16 @@ def _probe_pallas_training() -> bool:
     if _PALLAS_TRAIN_OK is None:
         try:
             from . import pallas_histogram
+            # F=2, B=64 resolves to the lane-ALIGNED kernel plan
+            # (fc*Bp = 128) — the same shape class production configs
+            # take; a tiny unaligned probe would validate the wrong path
             r, l = 256, 2
             out = pallas_histogram.build_histograms_pallas(
                 jnp.zeros((r, 2), jnp.uint8),
                 jnp.ones((r, HIST_CH), jnp.float32),
                 jnp.zeros((r,), jnp.int32),
                 jnp.arange(l, dtype=jnp.int32),
-                num_bins=4, hist_dtype="bfloat16")
+                num_bins=64, hist_dtype="bfloat16")
             jax.block_until_ready(out)
             _PALLAS_TRAIN_OK = True
         except Exception as e:  # Mosaic lowering / runtime rejection

@@ -98,17 +98,47 @@ except Exception:  # pragma: no cover
 
 
 def _plan_chunks(F: int, B: int, L: int, vmem_budget: int = 10 << 20):
-    """Pick (row_block, feature_chunk) so onehot + out fit VMEM."""
-    lb3 = L * HIST_CH
-    # feature chunk: cap Fc*B around 4096 lanes, divisor-friendly
-    fc = max(1, min(F, 4096 // max(B, 1)))
-    while F % fc != 0:
-        fc -= 1
-    # row block: onehot blk*fc*B*2 bytes within budget
-    blk = vmem_budget // max(1, fc * B * 2 + lb3 * 4)
-    blk = int(2 ** np.floor(np.log2(max(blk, 256))))
+    """Pick (row_block, feature_chunk, padded_bins, padded_leaves).
+
+    Mosaic-friendliness: the one-hot is built at ``Bp`` bins (power of
+    two >= B; bins >= B simply never match) and ``fc`` is chosen so
+    ``fc * Bp`` is a multiple of the 128-lane tile — then the kernel's
+    reshape/matmul operands are exactly lane-aligned and its pads
+    compile away. ``l_pad`` is lifted to a multiple of 128 for the same
+    reason (ghl width l_pad*3 is then 128-aligned). Shapes with no
+    aligned divisor fall back to in-kernel padding (still correct)."""
+    Bp = 1 << int(np.ceil(np.log2(max(B, 2))))
+    l_pad = max(128, -(-L // 128) * 128)
+    out_cap = 4 << 20      # resident accumulator block budget
+    # feature chunk: fc | F, fc * Bp ≡ 0 (mod 128), fc * Bp <= 4096,
+    # and the [fc*Bp, l_pad*3] f32 accumulator under its own cap (it
+    # stays VMEM-resident across the whole row stream)
+    fc = 0
+    for cand in range(min(F, max(1, 4096 // Bp)), 0, -1):
+        if F % cand == 0 and (cand * Bp) % 128 == 0 \
+                and cand * Bp * l_pad * HIST_CH * 4 <= out_cap:
+            fc = cand
+            break
+    if fc == 0:
+        # no aligned divisor (e.g. odd tiny F): legacy padding path,
+        # with the cheap narrow leaf pad (alignment can't compile away
+        # here anyway)
+        Bp = B
+        l_pad = max(8, -(-L // 8) * 8)
+        fc = max(1, min(F, 4096 // max(B, 1)))
+        while F % fc != 0 or (fc > 1 and -(-(fc * B) // 128) * 128
+                              * -(-(l_pad * HIST_CH) // 128) * 128 * 4
+                              > out_cap):
+            fc -= 1
+    out_b = (-(-(fc * Bp) // 128) * 128
+             * -(-(l_pad * HIST_CH) // 128) * 128 * 4)
+    # row block: onehot (cdt bytes, estimate 2) + double-buffered bins
+    # int32 + ghl row width, inside what the accumulator leaves free
+    per_row = fc * Bp * 2 + fc * 4 * 2 + l_pad * HIST_CH * 4
+    blk = max(256, (vmem_budget - out_b) // max(1, per_row))
+    blk = int(2 ** np.floor(np.log2(blk)))
     blk = min(blk, 4096)
-    return blk, fc
+    return blk, fc, Bp, l_pad
 
 
 @functools.partial(
@@ -136,7 +166,7 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
     quant = gh.dtype == jnp.int8
     cdt = jnp.int8 if quant else jnp.dtype(hist_dtype)
     acc_dt = jnp.int32 if quant else jnp.float32
-    blk, fc = _plan_chunks(F, B, L)
+    blk, fc, Bp, l_pad = _plan_chunks(F, B, L)
 
     r_pad = ((R + blk - 1) // blk) * blk
     if r_pad != R:
@@ -146,10 +176,9 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
 
     n_fb = F // fc
     n_rb = r_pad // blk
-    # tile-aligned paddings: matmul dims to 128 lanes; the tiny metadata
-    # operands to 8 sublanes so no block has a sub-tile minor shape
-    fb_pad = -(-(fc * B) // 128) * 128
-    l_pad = max(8, -(-L // 8) * 8)
+    # with an aligned plan these equal fc*Bp / l_pad*3 exactly and the
+    # kernel's pads compile away; otherwise they round up to the tile
+    fb_pad = -(-(fc * Bp) // 128) * 128
     lb3_pad = -(-(l_pad * HIST_CH) // 128) * 128
 
     gh8 = jnp.pad(gh, ((0, 0), (0, 8 - HIST_CH)))
@@ -160,7 +189,7 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
                 constant_values=-2)[None, :], (8, l_pad))
 
     out = pl.pallas_call(
-        functools.partial(_kernel, num_bins=B, cdt=cdt, fb_pad=fb_pad,
+        functools.partial(_kernel, num_bins=Bp, cdt=cdt, fb_pad=fb_pad,
                           lb3_pad=lb3_pad, acc_dt=acc_dt),
         grid=(n_fb, n_rb),
         in_specs=[
@@ -172,9 +201,14 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
         out_specs=pl.BlockSpec((fb_pad, lb3_pad), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((n_fb * fb_pad, lb3_pad),
                                        acc_dt),
+        # feature chunks are independent; the row dim revisits the same
+        # accumulator block and must stay sequential
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(bins.astype(jnp.int32), gh8, leaf8, lids8)
 
-    hist = out.reshape(n_fb, fb_pad, lb3_pad)[:, :fc * B, :l_pad * HIST_CH]
-    hist = hist.reshape(n_fb, fc, B, l_pad, HIST_CH)[:, :, :, :L, :]
+    hist = out.reshape(n_fb, fb_pad, lb3_pad)[:, :fc * Bp,
+                                              :l_pad * HIST_CH]
+    hist = hist.reshape(n_fb, fc, Bp, l_pad, HIST_CH)[:, :, :B, :L, :]
     return hist.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
