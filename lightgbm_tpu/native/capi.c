@@ -243,9 +243,21 @@ static int validate_tree(const CTree *t, int max_feature_idx) {
         if ((lc >= 0 && lc >= ni) || (lc < 0 && ~lc >= t->num_leaves) ||
             (rc >= 0 && rc >= ni) || (rc < 0 && ~rc >= t->num_leaves))
             return set_err("child index out of range");
+        /* internal children must point FORWARD (both this writer and the
+         * reference allocate child internal nodes after their parent), so
+         * node indices strictly increase along any root-to-leaf path:
+         * every walk terminates, and a crafted cycle (left_child[0]=0)
+         * fails the load instead of hanging tree_leaf */
+        if ((lc >= 0 && lc <= i) || (rc >= 0 && rc <= i))
+            return set_err("child index not after parent (cycle?)");
         if (t->decision_type[i] & 1) {
-            int ci = (int)t->threshold[i];
-            if (ci < 0 || ci >= t->num_cat)
+            /* range-check as double BEFORE the int cast: casting a NaN
+             * or out-of-int-range double is undefined behavior in C */
+            double ct = t->threshold[i];
+            if (!(ct >= 0.0 && ct < 2147483647.0))
+                return set_err("categorical threshold out of range");
+            int ci = (int)ct;
+            if (ci >= t->num_cat)
                 return set_err("categorical threshold out of range");
         }
     }
@@ -364,6 +376,17 @@ int LGBM_BoosterCreateFromModelfile(const char *filename,
     }
     free(line);
     free(buf);
+    /* booster-level header validation: the predict accumulator is sized
+     * num_class and indexed acc[t % num_tpi], so a corrupt/hand-edited
+     * header with num_tpi > num_class (or non-positive counts) would
+     * write past the heap buffer — such models must fail the load, the
+     * same contract validate_tree enforces per tree */
+    if (ok && (b->num_class < 1 || b->num_tpi < 1 ||
+               b->num_tpi > b->num_class || b->max_feature_idx < 0)) {
+        ok = 0;
+        set_err("invalid model header (num_class/num_tree_per_iteration/"
+                "max_feature_idx)");
+    }
     if (!ok || b->num_trees == 0) {
         if (ok) set_err("model file holds no trees");
         for (int i = 0; i < b->num_trees; i++) free_tree(&b->trees[i]);
@@ -408,19 +431,22 @@ static int tree_leaf(const CTree *t, const double *row) {
         int next;
         if (dt & 1) {                                   /* categorical */
             int go_right = 0;
-            if (isnan(v)) go_right = 1;
+            /* route NaN and out-of-int-range values right BEFORE the
+             * cast — (int)v on such doubles is undefined behavior (the
+             * reference's static_cast shares the hazard). v <= -1.0
+             * rather than v < 0.0 keeps the reference's truncation
+             * semantics: values in (-1, 0) cast to 0 and consult the
+             * bitset, exactly like tree.h CategoricalDecision */
+            if (isnan(v) || v <= -1.0 || v >= 2147483648.0) go_right = 1;
             else {
                 int iv = (int)v;
-                if (iv < 0) go_right = 1;
-                else {
-                    int ci = (int)t->threshold[node];
-                    int lo = t->cat_boundaries[ci];
-                    int n_words = t->cat_boundaries[ci + 1] - lo;
-                    if (iv >= n_words * 32 ||
-                        !((t->cat_threshold[lo + (iv >> 5)] >>
-                           (iv & 31)) & 1u))
-                        go_right = 1;
-                }
+                int ci = (int)t->threshold[node];
+                int lo = t->cat_boundaries[ci];
+                int n_words = t->cat_boundaries[ci + 1] - lo;
+                if (iv >= n_words * 32 ||
+                    !((t->cat_threshold[lo + (iv >> 5)] >>
+                       (iv & 31)) & 1u))
+                    go_right = 1;
             }
             next = go_right ? t->right_child[node] : t->left_child[node];
         } else {

@@ -105,10 +105,23 @@ def _probe_pallas_training() -> bool:
             _PALLAS_TRAIN_OK = True
         except Exception as e:  # Mosaic lowering / runtime rejection
             from .. import log as _log
+            # default to caching the False verdict (an unrecognized
+            # failure repeating the doomed probe compile on EVERY
+            # booster setup would stall each one for seconds); only a
+            # known-TRANSIENT class — momentary device OOM / device
+            # busy — leaves the cache unset so the next resolve retries
+            msg = f"{type(e).__name__}: {e}"
+            transient = any(s in msg for s in (
+                "RESOURCE_EXHAUSTED", "Resource exhausted",
+                "out of memory", "OOM", "DEADLINE_EXCEEDED",
+                "UNAVAILABLE", "ABORTED"))
             _log.warning(
                 "Pallas histogram kernel unavailable on this backend "
-                f"({type(e).__name__}: {e}); falling back to the XLA "
-                "matmul formulation")
+                f"({msg}); falling back to the XLA matmul formulation"
+                + (" (transient error — will re-probe on next resolve)"
+                   if transient else ""))
+            if transient:
+                return False
             _PALLAS_TRAIN_OK = False
     return _PALLAS_TRAIN_OK
 

@@ -151,6 +151,41 @@ def test_capi_error_paths(capi, tmp_path):
     assert rc == -1
 
 
+def test_capi_rejects_corrupt_models(capi, rng, tmp_path):
+    """Hand-edited models must fail the LOAD, not corrupt the predict:
+    a header with num_tree_per_iteration > num_class would overflow the
+    num_class-sized accumulator (acc[t % tpi]); a tree whose child
+    points back at itself would hang the unbounded walk (advisor r4)."""
+    X = rng.normal(size=(400, 3))
+    y = X[:, 0] + rng.normal(scale=0.1, size=400)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 3)
+    good = (tmp_path / "good.txt")
+    bst.save_model(str(good))
+    text = good.read_text()
+
+    def expect_reject(mutated, name):
+        p = tmp_path / name
+        p.write_text(mutated)
+        handle = ctypes.c_void_p()
+        iters = ctypes.c_int()
+        rc = capi.LGBM_BoosterCreateFromModelfile(
+            str(p).encode(), ctypes.byref(iters), ctypes.byref(handle))
+        assert rc == -1, f"{name} loaded but should have been rejected"
+
+    expect_reject(text.replace("num_tree_per_iteration=1",
+                               "num_tree_per_iteration=4"), "tpi.txt")
+    expect_reject(text.replace("num_class=1", "num_class=0"), "ncls.txt")
+    expect_reject(text.replace("max_feature_idx=2",
+                               "max_feature_idx=-1"), "mfi.txt")
+    # cycle: first internal node's left child points at itself (a
+    # non-negative child index <= its own node index)
+    import re
+    cyc = re.sub(r"left_child=(-?\d+)", "left_child=0", text, count=1)
+    expect_reject(cyc, "cycle.txt")
+
+
 def test_capi_objective_suffix_transforms(capi, rng, tmp_path):
     """xentlambda (1-exp(-exp(raw))) and regression-sqrt
     (sign(x)*x^2) are distinct NORMAL transforms; sigmoid:k must be
