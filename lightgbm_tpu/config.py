@@ -166,10 +166,12 @@ PARAMS: Dict[str, ParamSpec] = {
            doc="matmul input dtype for histogram accumulation: bfloat16 "
                "(default; f32 accumulate) or float32 (exact)"),
         _p("hist_impl", "auto", str,
-           check=lambda v: v in ("auto", "matmul", "scatter", "pallas"),
-           doc="histogram kernel: auto (pallas on tpu, scatter on cpu), "
-               "matmul (MXU one-hot), scatter (XLA scatter-add), pallas "
-               "(fused VMEM kernel)"),
+           check=lambda v: v in ("auto", "matmul", "scatter", "pallas",
+                                 "native"),
+           doc="histogram kernel: auto (pallas on tpu, native C on cpu "
+               "when a toolchain exists, else scatter), matmul (MXU "
+               "one-hot), scatter (XLA scatter-add), pallas (fused VMEM "
+               "kernel), native (runtime-compiled C host kernel)"),
         _p("hist_subtraction", True, bool,
            doc="histogram the smaller child only and derive the sibling "
                "by parent-minus-child subtraction from a per-leaf cache "

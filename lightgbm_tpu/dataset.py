@@ -28,7 +28,74 @@ from typing import Any, Dict, List, Optional, Union
 from .binning import BinMapper
 from .config import Config
 
-__all__ = ["Dataset", "Sequence"]
+__all__ = ["Dataset", "Sequence", "estimate_device_bytes",
+           "check_device_capacity"]
+
+
+def estimate_device_bytes(num_rows: int, width: int, itemsize: int,
+                          num_leaves: int, max_bin: int,
+                          hist_cache: bool, n_row_shards: int = 1) -> int:
+    """Per-device bytes of the training working set (capacity model,
+    VERDICT r4 #5). Device storage is the DENSE bundled bin matrix
+    sharded over data-parallel rows — the reference instead has
+    per-feature sparse storage (src/io/sparse_bin.hpp:1,
+    multi_val_sparse_bin.hpp:1) so its footprint scales with non-zeros.
+    Dominant terms per chip:
+      bins [R/shards, width] itemsize   (the matrix itself)
+      gh/scores/row_leaf ~ 4 x [R/shards] f32
+      hist cache [(L+1), width*B', 3] f32 when hist_subtraction is on
+    """
+    r_local = -(-num_rows // max(1, n_row_shards))
+    bins_b = r_local * width * itemsize
+    per_row = 4 * 4 * r_local                    # gh(3) + scores/row_leaf
+    cache_b = ((num_leaves + 1) * width * max_bin * 3 * 4
+               if hist_cache else 0)
+    return int(bins_b + per_row + cache_b)
+
+
+def check_device_capacity(num_rows: int, width: int, itemsize: int,
+                          num_leaves: int, max_bin: int,
+                          hist_cache: bool, n_row_shards: int = 1,
+                          headroom: float = 0.85) -> None:
+    """Raise MemoryError with sized guidance when the dense working set
+    cannot fit a device (instead of an opaque device OOM mid-training).
+
+    The budget comes from the backend's per-device memory when the
+    runtime reports one (TPU HBM), else from
+    ``LIGHTGBM_TPU_DEVICE_MEM_GB`` (also the test hook); with neither,
+    the check is skipped (CPU hosts page).
+    """
+    budget = None
+    env = os.environ.get("LIGHTGBM_TPU_DEVICE_MEM_GB")
+    if env:
+        budget = float(env) * (1 << 30)
+    else:
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats()
+            if stats and stats.get("bytes_limit"):
+                budget = float(stats["bytes_limit"])
+        except Exception:
+            budget = None
+    if not budget:
+        return
+    need = estimate_device_bytes(num_rows, width, itemsize, num_leaves,
+                                 max_bin, hist_cache, n_row_shards)
+    if need <= budget * headroom:
+        return
+    gib = 1 << 30
+    raise MemoryError(
+        f"training working set ~{need / gib:.1f} GiB per device exceeds "
+        f"{budget * headroom / gib:.1f} GiB available "
+        f"({num_rows:,} rows x {width:,} stored columns x {itemsize} B "
+        f"over {n_row_shards} row shard(s)). Device storage is the "
+        "DENSE bundled bin matrix — wide sparse data fits only when its "
+        "columns are mutually exclusive enough to bundle (EFB). "
+        "Options: enable_bundle=true with a larger max_conflict_rate; "
+        "max_bin<=255 keeps columns uint8; shard rows over more "
+        "devices/hosts (tree_learner=data); or reduce features "
+        "up-front. The reference's sparse_bin.hpp storage has no dense "
+        "analog here yet (README 'Sparse data').")
 
 
 class Sequence:

@@ -20,26 +20,30 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["native_lib", "capi_lib", "parse_delimited", "parse_libsvm"]
+__all__ = ["native_lib", "capi_lib", "hist_lib",
+           "parse_delimited", "parse_libsvm"]
 
 _LIB = None
 _TRIED = False
 _CAPI = None
 _CAPI_TRIED = False
+_HIST = None
+_HIST_TRIED = False
 
 _DOUBLE_P = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 
 
 
 
-def _compile_and_load(src_name: str, so_prefix: str, extra_gcc=()):
-    """gcc-compile a bundled C source into the content-hashed per-user
+def _compile_and_load(src_name: str, so_prefix: str, extra_gcc=(),
+                      compiler: str = "gcc"):
+    """Compile a bundled C/C++ source into the content-hashed per-user
     cache (0700 — a predictable /tmp path would let another local user
     pre-plant a malicious .so) and ctypes-load it. Raises on failure."""
     src = os.path.join(os.path.dirname(__file__), src_name)
     with open(src, "rb") as f:
         code = f.read()
-    tag = hashlib.sha256(code).hexdigest()[:16]
+    tag = hashlib.sha256(code + repr(extra_gcc).encode()).hexdigest()[:16]
     cache_dir = os.environ.get("LIGHTGBM_TPU_CACHE") or os.path.join(
         os.path.expanduser("~"), ".cache", "lightgbm_tpu")
     os.makedirs(cache_dir, mode=0o700, exist_ok=True)
@@ -47,9 +51,9 @@ def _compile_and_load(src_name: str, so_prefix: str, extra_gcc=()):
     if not os.path.exists(so):
         tmp = f"{so}.{os.getpid()}.tmp"
         subprocess.run(
-            ["gcc", "-O3", "-shared", "-fPIC", "-o", tmp, src,
+            [compiler, "-O3", "-shared", "-fPIC", "-o", tmp, src,
              *extra_gcc],
-            check=True, capture_output=True, timeout=120)
+            check=True, capture_output=True, timeout=300)
         os.replace(tmp, so)  # atomic: concurrent builders both win
     return ctypes.CDLL(so)
 
@@ -77,6 +81,17 @@ def native_lib():
         lib.lgbtpu_parse_libsvm.argtypes = [
             ctypes.c_char_p, ctypes.c_long, ctypes.c_long, ctypes.c_long,
             _DOUBLE_P, _DOUBLE_P]
+        lib.lgbtpu_greedy_bounds.restype = ctypes.c_long
+        lib.lgbtpu_greedy_bounds.argtypes = [
+            _DOUBLE_P, np.ctypeslib.ndpointer(np.int64,
+                                              flags="C_CONTIGUOUS"),
+            ctypes.c_long, ctypes.c_long, ctypes.c_double, ctypes.c_long,
+            _DOUBLE_P]
+        lib.lgbtpu_values_to_bins.restype = None
+        lib.lgbtpu_values_to_bins.argtypes = [
+            _DOUBLE_P, ctypes.c_long, _DOUBLE_P, ctypes.c_long,
+            ctypes.c_long,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")]
         _LIB = lib
     except Exception:
         _LIB = None
@@ -117,6 +132,87 @@ def capi_lib():
     except Exception:
         _CAPI = None
     return _CAPI
+
+
+_INT32_P = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def hist_lib():
+    """True when the native histogram kernel is compiled AND registered
+    as an XLA FFI custom-call pair ("lgbtpu_hist_f32"/"lgbtpu_hist_i8",
+    platform cpu); None when unavailable.
+
+    The kernel (hist.c loops wrapped by hist_ffi.cc) is the CPU-backend
+    analog of the device kernels in ops/histogram.py — dense_bin.hpp:105
+    ConstructHistogram cache locality — and runs on XLA's compute thread
+    with no GIL or host round-trip (a jax.pure_callback would deadlock a
+    single-threaded CPU client waiting on its own executor)."""
+    global _HIST, _HIST_TRIED
+    if _HIST_TRIED:
+        return _HIST
+    _HIST_TRIED = True
+    if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+        return None
+    try:
+        import jax
+        inc = jax.ffi.include_dir()
+        lib = _compile_and_load(
+            "hist_ffi.cc", "lightgbm_tpu_hist_ffi",
+            extra_gcc=("-std=c++17", f"-I{inc}"), compiler="g++")
+        jax.ffi.register_ffi_target(
+            "lgbtpu_hist_f32", jax.ffi.pycapsule(lib.LgbtpuHistF32),
+            platform="cpu")
+        jax.ffi.register_ffi_target(
+            "lgbtpu_hist_i8", jax.ffi.pycapsule(lib.LgbtpuHistI8),
+            platform="cpu")
+        jax.ffi.register_ffi_target(
+            "lgbtpu_relabel", jax.ffi.pycapsule(lib.LgbtpuRelabel),
+            platform="cpu")
+        jax.ffi.register_ffi_target(
+            "lgbtpu_partition", jax.ffi.pycapsule(lib.LgbtpuPartition),
+            platform="cpu")
+        jax.ffi.register_ffi_target(
+            "lgbtpu_hist_perm_f32",
+            jax.ffi.pycapsule(lib.LgbtpuHistPermF32), platform="cpu")
+        jax.ffi.register_ffi_target(
+            "lgbtpu_hist_perm_i8",
+            jax.ffi.pycapsule(lib.LgbtpuHistPermI8), platform="cpu")
+        _HIST = lib
+    except Exception:
+        _HIST = None
+    return _HIST
+
+
+def greedy_bounds(distinct: np.ndarray, counts: np.ndarray,
+                  max_bin: int, total_cnt: float,
+                  min_data_in_bin: int) -> Optional[np.ndarray]:
+    """Fast path for binning._greedy_find_bin. None -> caller falls
+    back to the (exact-identical) Python loop."""
+    lib = native_lib()
+    if lib is None:
+        return None
+    distinct = np.ascontiguousarray(distinct, np.float64)
+    counts = np.ascontiguousarray(counts, np.int64)
+    out = np.empty(max(int(max_bin), 1) + 1, np.float64)
+    n = lib.lgbtpu_greedy_bounds(distinct, counts, len(distinct),
+                                 int(max_bin), float(total_cnt),
+                                 int(min_data_in_bin), out)
+    return out[:n]
+
+
+def values_to_bins(values: np.ndarray, upper_bounds: np.ndarray,
+                   nan_bin: int) -> Optional[np.ndarray]:
+    """Fast path for BinMapper.values_to_bins (numerical features).
+    None -> caller falls back to searchsorted."""
+    lib = native_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.float64)
+    upper_bounds = np.ascontiguousarray(upper_bounds, np.float64)
+    out = np.empty(len(values), np.int32)
+    lib.lgbtpu_values_to_bins(values, len(values), upper_bounds,
+                              len(upper_bounds), int(nan_bin), out)
+    return out
 
 
 def parse_delimited(lines, delim: str) -> Optional[np.ndarray]:

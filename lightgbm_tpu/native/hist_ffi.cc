@@ -1,0 +1,685 @@
+// XLA FFI custom-call wrapper for the native CPU histogram kernel.
+//
+// The C loops in hist.c run here as a REGISTERED XLA CUSTOM CALL on the
+// CPU backend (jax.ffi), not a Python callback: the handler executes on
+// XLA's compute thread with no GIL and no host round-trip, so it is
+// legal inside jit/while_loop/shard_map — the same integration class as
+// the reference's compiled kernels (src/io/dense_bin.hpp:105
+// ConstructHistogram called from the C++ tree learner), realized the
+// XLA-native way.
+//
+// Operands (all host/CPU buffers):
+//   bins       [R, F]  u8 | s32   dense bin matrix
+//   gh         [Rc, 3] f32 | s8   (grad, hess, count) — compacted
+//   row_leaf   [Rc]    s32        leaf slot per stream position, -1 dead
+//   leaf_ids   [L]     s32        slots to build (-2 sentinels allowed)
+//   row_gather [Rc|1]  s32        bins-row per stream position
+//   num_rows   [1]     s32        live stream bound
+// Attrs: bf16_round (f32 only), use_gather.
+// Result: [L, F, B, 3] f32 (s32 for the s8 variant), zeroed here.
+//
+// Compiled at first use by native/__init__.py with
+// `g++ -O3 -shared -fPIC -I $(jax.ffi.include_dir())` and registered
+// via jax.ffi.register_ffi_target; ops/histogram.py falls back to the
+// XLA scatter formulation when the toolchain is missing.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+#include <type_traits>
+
+#if defined(__SSE2__) || defined(_M_X64)
+#include <emmintrin.h>
+#define LGBTPU_SSE2 1
+#endif
+
+#include "xla/ffi/api/c_api.h"
+#include "xla/ffi/api/ffi.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+inline float bf16_round_f(float x) {
+  uint32_t u;
+  std::memcpy(&u, &x, 4);
+  u += ((u >> 16) & 1u) + 0x7fffu;
+  u &= 0xffff0000u;
+  float y;
+  std::memcpy(&y, &u, 4);
+  return y;
+}
+
+// Build slot -> output-row LUT from leaf_ids (slots are small ints).
+inline void build_lut(const int32_t* leaf_ids, int64_t L,
+                      std::vector<int32_t>& lut) {
+  int32_t max_slot = -1;
+  for (int64_t i = 0; i < L; i++)
+    if (leaf_ids[i] > max_slot) max_slot = leaf_ids[i];
+  lut.assign(static_cast<size_t>(max_slot) + 1, -1);
+  for (int64_t i = 0; i < L; i++)
+    if (leaf_ids[i] >= 0) lut[leaf_ids[i]] = static_cast<int32_t>(i);
+}
+
+template <typename BinT, typename GhT, typename AccT, bool kBf16>
+void hist_core(const BinT* bins, const GhT* gh, const int32_t* row_leaf,
+               const std::vector<int32_t>& lut, const int32_t* row_gather,
+               int64_t num_rows, int64_t F, int64_t B, AccT* out) {
+  const int64_t lut_size = static_cast<int64_t>(lut.size());
+  const int64_t FB3 = F * B * 3;
+  for (int64_t r = 0; r < num_rows; r++) {
+    const int32_t rl = row_leaf[r];
+    if (rl < 0 || rl >= lut_size) continue;
+    const int32_t li = lut[rl];
+    if (li < 0) continue;
+    const int64_t row = row_gather ? static_cast<int64_t>(row_gather[r]) : r;
+    AccT g = static_cast<AccT>(gh[r * 3]);
+    AccT h = static_cast<AccT>(gh[r * 3 + 1]);
+    AccT c = static_cast<AccT>(gh[r * 3 + 2]);
+    if (kBf16) {
+      g = bf16_round_f(g);
+      h = bf16_round_f(h);
+      c = bf16_round_f(c);
+    }
+    AccT* hb = out + static_cast<int64_t>(li) * FB3;
+    const BinT* br = bins + row * F;
+    for (int64_t f = 0; f < F; f++) {
+      const int64_t bv = static_cast<int64_t>(br[f]);
+      if (bv < 0 || bv >= B) continue;   // defensive (B < dtype range)
+      AccT* cell = hb + (f * B + bv) * 3;
+      cell[0] += g;
+      cell[1] += h;
+      cell[2] += c;
+    }
+  }
+}
+
+template <typename GhT, typename AccT>
+ffi::Error HistImpl(ffi::AnyBuffer bins, ffi::AnyBuffer gh,
+                    ffi::AnyBuffer row_leaf, ffi::AnyBuffer leaf_ids,
+                    ffi::AnyBuffer row_gather, ffi::AnyBuffer num_rows,
+                    bool bf16_round, bool use_gather,
+                    ffi::Result<ffi::AnyBuffer> out) {
+  auto bdims = bins.dimensions();
+  auto odims = out->dimensions();
+  if (bdims.size() != 2 || odims.size() != 4)
+    return ffi::Error::InvalidArgument("hist: bad operand ranks");
+  const int64_t F = bdims[1];
+  const int64_t L = odims[0];
+  const int64_t B = odims[2];
+  if (odims[1] != F || odims[3] != 3)
+    return ffi::Error::InvalidArgument("hist: bad output shape");
+  if (leaf_ids.element_count() != L)
+    return ffi::Error::InvalidArgument("hist: leaf_ids/output mismatch");
+  const int64_t Rc = row_leaf.element_count();
+  if (gh.element_count() != Rc * 3)
+    return ffi::Error::InvalidArgument("hist: gh/row_leaf mismatch");
+
+  const int32_t* rl = reinterpret_cast<const int32_t*>(row_leaf.untyped_data());
+  const int32_t* lid = reinterpret_cast<const int32_t*>(leaf_ids.untyped_data());
+  const int32_t* rg =
+      use_gather ? reinterpret_cast<const int32_t*>(row_gather.untyped_data())
+                 : nullptr;
+  if (use_gather && row_gather.element_count() < Rc)
+    return ffi::Error::InvalidArgument("hist: short row_gather");
+  int64_t nr = *reinterpret_cast<const int32_t*>(num_rows.untyped_data());
+  if (nr < 0) nr = 0;
+  if (nr > Rc) nr = Rc;
+  // without a gather the stream indexes bins directly: bound by R too
+  if (!use_gather && nr > bdims[0]) nr = bdims[0];
+
+  std::vector<int32_t> lut;
+  build_lut(lid, L, lut);
+
+  const GhT* ghp = reinterpret_cast<const GhT*>(gh.untyped_data());
+  AccT* op = reinterpret_cast<AccT*>(out->untyped_data());
+  std::memset(op, 0, static_cast<size_t>(L * F * B * 3) * sizeof(AccT));
+
+  const bool u8 = bins.element_type() == ffi::U8;
+  const void* bp = bins.untyped_data();
+  if (u8) {
+    if (bf16_round)
+      hist_core<uint8_t, GhT, AccT, true>(
+          reinterpret_cast<const uint8_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+    else
+      hist_core<uint8_t, GhT, AccT, false>(
+          reinterpret_cast<const uint8_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+  } else {
+    if (bf16_round)
+      hist_core<int32_t, GhT, AccT, true>(
+          reinterpret_cast<const int32_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+    else
+      hist_core<int32_t, GhT, AccT, false>(
+          reinterpret_cast<const int32_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+  }
+  return ffi::Error::Success();
+}
+
+ffi::Error HistF32Impl(ffi::AnyBuffer bins, ffi::AnyBuffer gh,
+                       ffi::AnyBuffer row_leaf, ffi::AnyBuffer leaf_ids,
+                       ffi::AnyBuffer row_gather, ffi::AnyBuffer num_rows,
+                       bool bf16_round, bool use_gather,
+                       ffi::Result<ffi::AnyBuffer> out) {
+  return HistImpl<float, float>(bins, gh, row_leaf, leaf_ids, row_gather,
+                                num_rows, bf16_round, use_gather, out);
+}
+
+ffi::Error HistI8Impl(ffi::AnyBuffer bins, ffi::AnyBuffer gh,
+                      ffi::AnyBuffer row_leaf, ffi::AnyBuffer leaf_ids,
+                      ffi::AnyBuffer row_gather, ffi::AnyBuffer num_rows,
+                      bool bf16_round, bool use_gather,
+                      ffi::Result<ffi::AnyBuffer> out) {
+  (void)bf16_round;  // int8 accumulates exactly; no rounding
+  return HistImpl<int8_t, int32_t>(bins, gh, row_leaf, leaf_ids, row_gather,
+                                   num_rows, false, use_gather, out);
+}
+
+// DataPartition::Split (the relabel pass of tree_builder.relabel) as a
+// custom call: rows whose leaf is not splitting this round short-circuit
+// after a 4-byte row_leaf read — the XLA formulation streams the pend_*
+// gather/select chain over every row every round (~16 ms/round at 1M
+// rows, measured). Decision semantics match tree_builder.relabel (and
+// tree.h NumericalDecision bin space) exactly.
+//
+// Operands: bins [R,F] u8|s32, row_leaf [R] s32, pend_active [L+1] u8,
+// pend_feat [L+1] s32, pend_thr [L+1] s32, pend_dl [L+1] u8,
+// pend_cat [L+1] u8, pend_right [L+1] s32, pend_bits [L+1, BW] u32,
+// nan_bin_pf [F] s32. Result: new row_leaf [R] s32.
+template <typename BinT, bool kColMajor>
+void relabel_core(const BinT* bins, const int32_t* rl_in, int64_t R,
+                  int64_t F, int64_t n_slots, const uint8_t* active,
+                  const int32_t* feat, const int32_t* thr,
+                  const uint8_t* dl, const uint8_t* cat,
+                  const int32_t* right, const uint32_t* bits, int64_t BW,
+                  const int32_t* nan_bin_pf, int32_t* out) {
+  for (int64_t r = 0; r < R; r++) {
+    const int32_t rl = rl_in[r];
+    out[r] = rl;
+    if (rl < 0 || rl >= n_slots || !active[rl]) continue;
+    const int32_t f = feat[rl];
+    if (f < 0 || f >= F) continue;
+    // column-major ([F, R] transposed copy): reading one feature byte
+    // costs ~1 B/row instead of the 64 B cache line a row-major row
+    // pulls in — the same reason the reference stores per-feature
+    // columns (dense_bin.hpp, one DenseBin per feature)
+    const int64_t bv = static_cast<int64_t>(
+        kColMajor ? bins[static_cast<int64_t>(f) * R + r]
+                  : bins[r * F + f]);
+    bool go_left;
+    if (cat[rl]) {
+      const int64_t w = bv >> 5;
+      go_left = w < BW && ((bits[rl * BW + w] >> (bv & 31)) & 1u);
+    } else if (nan_bin_pf[f] >= 0 && bv == nan_bin_pf[f]) {
+      go_left = dl[rl];
+    } else {
+      go_left = bv <= thr[rl];
+    }
+    if (!go_left) out[r] = right[rl];
+  }
+}
+
+// Shared split-decision (tree_builder.relabel / tree.h bin-space
+// NumericalDecision semantics), used by the partition op.
+inline bool decide_left(int64_t bv, int32_t slot, const int32_t* thr,
+                        const uint8_t* dl, const uint8_t* cat,
+                        const uint32_t* bits, int64_t BW,
+                        int32_t nan_bin) {
+  if (cat[slot]) {
+    const int64_t w = bv >> 5;
+    return w < BW && ((bits[slot * BW + w] >> (bv & 31)) & 1u);
+  }
+  if (nan_bin >= 0 && bv == nan_bin) return dl[slot];
+  return bv <= thr[slot];
+}
+
+ffi::Error RelabelImpl(ffi::AnyBuffer bins, ffi::AnyBuffer row_leaf,
+                       ffi::AnyBuffer active, ffi::AnyBuffer feat,
+                       ffi::AnyBuffer thr, ffi::AnyBuffer dl,
+                       ffi::AnyBuffer cat, ffi::AnyBuffer right,
+                       ffi::AnyBuffer bits, ffi::AnyBuffer nan_bin_pf,
+                       bool col_major, ffi::Result<ffi::AnyBuffer> out) {
+  auto bdims = bins.dimensions();
+  if (bdims.size() != 2)
+    return ffi::Error::InvalidArgument("relabel: bins must be 2-D");
+  const int64_t R = bdims[col_major ? 1 : 0];
+  const int64_t F = bdims[col_major ? 0 : 1];
+  if (row_leaf.element_count() != R || out->element_count() != R)
+    return ffi::Error::InvalidArgument("relabel: row_leaf/out mismatch");
+  const int64_t n_slots = active.element_count();
+  if (feat.element_count() != n_slots || thr.element_count() != n_slots ||
+      dl.element_count() != n_slots || cat.element_count() != n_slots ||
+      right.element_count() != n_slots ||
+      nan_bin_pf.element_count() != F)
+    return ffi::Error::InvalidArgument("relabel: pend_* size mismatch");
+  auto bitdims = bits.dimensions();
+  if (bitdims.size() != 2 || bitdims[0] != n_slots)
+    return ffi::Error::InvalidArgument("relabel: bits must be [L+1, BW]");
+  const int64_t BW = bitdims[1];
+
+  const int32_t* rl = reinterpret_cast<const int32_t*>(row_leaf.untyped_data());
+  const uint8_t* ac = reinterpret_cast<const uint8_t*>(active.untyped_data());
+  const int32_t* ft = reinterpret_cast<const int32_t*>(feat.untyped_data());
+  const int32_t* th = reinterpret_cast<const int32_t*>(thr.untyped_data());
+  const uint8_t* dlp = reinterpret_cast<const uint8_t*>(dl.untyped_data());
+  const uint8_t* ct = reinterpret_cast<const uint8_t*>(cat.untyped_data());
+  const int32_t* rt = reinterpret_cast<const int32_t*>(right.untyped_data());
+  const uint32_t* bt = reinterpret_cast<const uint32_t*>(bits.untyped_data());
+  const int32_t* nb = reinterpret_cast<const int32_t*>(nan_bin_pf.untyped_data());
+  int32_t* op = reinterpret_cast<int32_t*>(out->untyped_data());
+
+  const bool u8 = bins.element_type() == ffi::U8;
+  const void* bp = bins.untyped_data();
+  if (u8 && col_major)
+    relabel_core<uint8_t, true>(reinterpret_cast<const uint8_t*>(bp), rl,
+                                R, F, n_slots, ac, ft, th, dlp, ct, rt,
+                                bt, BW, nb, op);
+  else if (u8)
+    relabel_core<uint8_t, false>(reinterpret_cast<const uint8_t*>(bp), rl,
+                                 R, F, n_slots, ac, ft, th, dlp, ct, rt,
+                                 bt, BW, nb, op);
+  else if (col_major)
+    relabel_core<int32_t, true>(reinterpret_cast<const int32_t*>(bp), rl,
+                                R, F, n_slots, ac, ft, th, dlp, ct, rt,
+                                bt, BW, nb, op);
+  else
+    relabel_core<int32_t, false>(reinterpret_cast<const int32_t*>(bp), rl,
+                                 R, F, n_slots, ac, ft, th, dlp, ct, rt,
+                                 bt, BW, nb, op);
+  return ffi::Error::Success();
+}
+
+// DataPartition::Split (data_partition.hpp semantics, realized as a
+// loop-carried ordered index set): `perm` holds row indices grouped by
+// leaf slot, `leaf_begin/leaf_cnt` delimit each slot's contiguous
+// segment. Splitting a leaf stably partitions ITS segment in place —
+// the left child keeps the front of the parent's range, the right
+// child takes the back (exactly data_partition.hpp:116 Split) — so
+// only the split leaves' rows are touched and histogram construction
+// can walk a child's rows with no scan over R and no branch per row.
+//
+// Operands: bins ([R,F] row-major or [F,R] col-major per attr),
+// row_leaf [R] s32, perm [R] s32, leaf_begin [L+1] s32,
+// leaf_cnt [L+1] s32, pend_active/feat/thr/dl/cat/right [L+1],
+// pend_bits [L+1, BW] u32, nan_bin_pf [F] s32.
+// Results: new row_leaf, perm, leaf_begin, leaf_cnt.
+template <typename BinT, bool kColMajor>
+void partition_core(const BinT* bins, int64_t R, int64_t F,
+                    int64_t n_slots, const uint8_t* active,
+                    const int32_t* feat, const int32_t* thr,
+                    const uint8_t* dl, const uint8_t* cat,
+                    const int32_t* right, const uint32_t* bits,
+                    int64_t BW, const int32_t* nan_bin_pf,
+                    int32_t* rl_out, int32_t* perm_out,
+                    int32_t* begin_out, int32_t* cnt_out) {
+  // alias-safe: each split segment is copied to scratch before being
+  // rewritten in place (perm_out may BE the input buffer when XLA
+  // donates the loop carry via input_output_aliases)
+  std::vector<int32_t> seg;
+  for (int32_t s = 0; s < n_slots; s++) {
+    if (!active[s]) continue;
+    const int32_t f = feat[s];
+    if (f < 0 || f >= F) continue;
+    const int32_t rs = right[s];
+    if (rs < 0 || rs >= n_slots || rs == s) continue;
+    const int64_t b = begin_out[s];
+    const int64_t c = cnt_out[s];
+    if (b < 0 || c < 0 || b + c > R) continue;   // corrupt state guard
+    const int32_t nb = nan_bin_pf[f];
+    seg.assign(perm_out + b, perm_out + b + c);
+    int64_t nl = 0;
+    int64_t nr = 0;
+    for (int64_t i = 0; i < c; i++) {
+      if (i + 16 < c) {
+        const int64_t rp = seg[i + 16];
+        __builtin_prefetch(kColMajor
+                               ? bins + static_cast<int64_t>(f) * R + rp
+                               : bins + rp * F + f);
+      }
+      const int32_t r = seg[i];
+      const int64_t bv = static_cast<int64_t>(
+          kColMajor ? bins[static_cast<int64_t>(f) * R + r]
+                    : bins[static_cast<int64_t>(r) * F + f]);
+      if (decide_left(bv, s, thr, dl, cat, bits, BW, nb)) {
+        perm_out[b + nl++] = r;
+      } else {
+        // rights go to the BACK of the parent's range, order preserved:
+        // fill from the end backwards, then reverse once
+        perm_out[b + c - 1 - nr++] = r;
+        rl_out[r] = rs;
+      }
+    }
+    // restore stable order of the right block (it was written reversed)
+    for (int64_t i = 0; i < nr / 2; i++) {
+      std::swap(perm_out[b + nl + i], perm_out[b + c - 1 - i]);
+    }
+    begin_out[s] = static_cast<int32_t>(b);
+    cnt_out[s] = static_cast<int32_t>(nl);
+    begin_out[rs] = static_cast<int32_t>(b + nl);
+    cnt_out[rs] = static_cast<int32_t>(c - nl);
+  }
+}
+
+ffi::Error PartitionImpl(ffi::AnyBuffer bins, ffi::AnyBuffer row_leaf,
+                         ffi::AnyBuffer perm, ffi::AnyBuffer leaf_begin,
+                         ffi::AnyBuffer leaf_cnt, ffi::AnyBuffer active,
+                         ffi::AnyBuffer feat, ffi::AnyBuffer thr,
+                         ffi::AnyBuffer dl, ffi::AnyBuffer cat,
+                         ffi::AnyBuffer right, ffi::AnyBuffer bits,
+                         ffi::AnyBuffer nan_bin_pf, bool col_major,
+                         ffi::Result<ffi::AnyBuffer> rl_out,
+                         ffi::Result<ffi::AnyBuffer> perm_out,
+                         ffi::Result<ffi::AnyBuffer> begin_out,
+                         ffi::Result<ffi::AnyBuffer> cnt_out) {
+  auto bdims = bins.dimensions();
+  if (bdims.size() != 2)
+    return ffi::Error::InvalidArgument("partition: bins must be 2-D");
+  const int64_t R = bdims[col_major ? 1 : 0];
+  const int64_t F = bdims[col_major ? 0 : 1];
+  if (row_leaf.element_count() != R || perm.element_count() != R ||
+      rl_out->element_count() != R || perm_out->element_count() != R)
+    return ffi::Error::InvalidArgument("partition: R mismatch");
+  const int64_t n_slots = active.element_count();
+  if (leaf_begin.element_count() != n_slots ||
+      leaf_cnt.element_count() != n_slots ||
+      begin_out->element_count() != n_slots ||
+      cnt_out->element_count() != n_slots ||
+      feat.element_count() != n_slots ||
+      thr.element_count() != n_slots || dl.element_count() != n_slots ||
+      cat.element_count() != n_slots ||
+      right.element_count() != n_slots ||
+      nan_bin_pf.element_count() != F)
+    return ffi::Error::InvalidArgument("partition: slot size mismatch");
+  auto bitdims = bits.dimensions();
+  if (bitdims.size() != 2 || bitdims[0] != n_slots)
+    return ffi::Error::InvalidArgument("partition: bad bits shape");
+  const int64_t BW = bitdims[1];
+
+  int32_t* rl = reinterpret_cast<int32_t*>(rl_out->untyped_data());
+  int32_t* pm = reinterpret_cast<int32_t*>(perm_out->untyped_data());
+  int32_t* bg = reinterpret_cast<int32_t*>(begin_out->untyped_data());
+  int32_t* ct = reinterpret_cast<int32_t*>(cnt_out->untyped_data());
+  // with input_output_aliases the carry buffers arrive donated (in
+  // place); only copy when XLA handed us distinct buffers
+  if (rl != row_leaf.untyped_data())
+    std::memcpy(rl, row_leaf.untyped_data(), R * sizeof(int32_t));
+  if (pm != perm.untyped_data())
+    std::memcpy(pm, perm.untyped_data(), R * sizeof(int32_t));
+  if (bg != leaf_begin.untyped_data())
+    std::memcpy(bg, leaf_begin.untyped_data(),
+                n_slots * sizeof(int32_t));
+  if (ct != leaf_cnt.untyped_data())
+    std::memcpy(ct, leaf_cnt.untyped_data(),
+                n_slots * sizeof(int32_t));
+
+  const uint8_t* ac = reinterpret_cast<const uint8_t*>(active.untyped_data());
+  const int32_t* ft = reinterpret_cast<const int32_t*>(feat.untyped_data());
+  const int32_t* th = reinterpret_cast<const int32_t*>(thr.untyped_data());
+  const uint8_t* dlp = reinterpret_cast<const uint8_t*>(dl.untyped_data());
+  const uint8_t* ctg = reinterpret_cast<const uint8_t*>(cat.untyped_data());
+  const int32_t* rt = reinterpret_cast<const int32_t*>(right.untyped_data());
+  const uint32_t* bt = reinterpret_cast<const uint32_t*>(bits.untyped_data());
+  const int32_t* nb = reinterpret_cast<const int32_t*>(nan_bin_pf.untyped_data());
+
+  const bool u8 = bins.element_type() == ffi::U8;
+  const void* bp = bins.untyped_data();
+  if (u8 && col_major)
+    partition_core<uint8_t, true>(reinterpret_cast<const uint8_t*>(bp),
+                                  R, F, n_slots, ac, ft, th, dlp, ctg,
+                                  rt, bt, BW, nb, rl, pm, bg, ct);
+  else if (u8)
+    partition_core<uint8_t, false>(reinterpret_cast<const uint8_t*>(bp),
+                                   R, F, n_slots, ac, ft, th, dlp, ctg,
+                                   rt, bt, BW, nb, rl, pm, bg, ct);
+  else if (col_major)
+    partition_core<int32_t, true>(reinterpret_cast<const int32_t*>(bp),
+                                  R, F, n_slots, ac, ft, th, dlp, ctg,
+                                  rt, bt, BW, nb, rl, pm, bg, ct);
+  else
+    partition_core<int32_t, false>(reinterpret_cast<const int32_t*>(bp),
+                                   R, F, n_slots, ac, ft, th, dlp, ctg,
+                                   rt, bt, BW, nb, rl, pm, bg, ct);
+  return ffi::Error::Success();
+}
+
+// Histogram over the partition's ordered row lists: walks exactly the
+// requested slots' segments (no scan over R, no per-row branch) — the
+// native analog of dense_bin.hpp:105 ConstructHistogram iterating
+// data_indices of one leaf.
+template <typename BinT, typename GhT, typename AccT, bool kBf16>
+void hist_perm_core(const BinT* bins, const GhT* gh, const int32_t* perm,
+                    const int32_t* begin, const int32_t* cnt,
+                    int64_t n_slots, const int32_t* leaf_ids, int64_t S,
+                    int64_t R, int64_t F, int64_t B, AccT* out) {
+  const int64_t FB3 = F * B * 3;
+  // accumulate into a 4-channel padded scratch so the per-(row,feature)
+  // update is ONE 16-byte SIMD load+add+store instead of three scalar
+  // read-modify-writes (the inner loop is store-port bound otherwise);
+  // folded back to the tight 3-channel layout per slot
+  std::vector<AccT> scratch;
+  for (int64_t j = 0; j < S; j++) {
+    const int32_t s = leaf_ids[j];
+    if (s < 0 || s >= n_slots) continue;
+    const int64_t b = begin[s];
+    const int64_t c = cnt[s];
+    if (b < 0 || c <= 0 || b + c > R) continue;
+    scratch.assign(static_cast<size_t>(F * B * 4), AccT(0));
+    AccT* sc = scratch.data();
+    for (int64_t i = b; i < b + c; i++) {
+      // deep leaves' rows are far apart: without prefetch the walk is
+      // DRAM-latency bound (~84 ns/row measured); overlap the misses
+      if (i + 16 < b + c) {
+        const int64_t rp = perm[i + 16];
+        __builtin_prefetch(bins + rp * F);
+        __builtin_prefetch(bins + rp * F + F - 1);   // row may straddle
+        __builtin_prefetch(gh + rp * 3);
+      }
+      const int64_t r = perm[i];
+      AccT g = static_cast<AccT>(gh[r * 3]);
+      AccT h = static_cast<AccT>(gh[r * 3 + 1]);
+      AccT cc = static_cast<AccT>(gh[r * 3 + 2]);
+      if (kBf16) {
+        g = bf16_round_f(g);
+        h = bf16_round_f(h);
+        cc = bf16_round_f(cc);
+      }
+      const BinT* br = bins + r * F;
+#if LGBTPU_SSE2
+      alignas(16) AccT ghq[4] = {g, h, cc, AccT(0)};
+      __m128 ghv_f = _mm_setzero_ps();
+      __m128i ghv_i = _mm_setzero_si128();
+      if constexpr (std::is_floating_point<AccT>::value)
+        ghv_f = _mm_load_ps(reinterpret_cast<const float*>(ghq));
+      else
+        ghv_i = _mm_load_si128(reinterpret_cast<const __m128i*>(ghq));
+#endif
+      for (int64_t f = 0; f < F; f++) {
+        const int64_t bv = static_cast<int64_t>(br[f]);
+        if (bv < 0 || bv >= B) continue;
+        AccT* cell = sc + (f * B + bv) * 4;
+#if LGBTPU_SSE2
+        if constexpr (std::is_floating_point<AccT>::value) {
+          float* cf = reinterpret_cast<float*>(cell);
+          _mm_storeu_ps(cf, _mm_add_ps(_mm_loadu_ps(cf), ghv_f));
+        } else {
+          __m128i* ci = reinterpret_cast<__m128i*>(cell);
+          _mm_storeu_si128(
+              ci, _mm_add_epi32(_mm_loadu_si128(ci), ghv_i));
+        }
+#else
+        cell[0] += g;
+        cell[1] += h;
+        cell[2] += cc;
+#endif
+      }
+    }
+    AccT* hb = out + j * FB3;
+    for (int64_t k = 0; k < F * B; k++) {
+      hb[k * 3] = sc[k * 4];
+      hb[k * 3 + 1] = sc[k * 4 + 1];
+      hb[k * 3 + 2] = sc[k * 4 + 2];
+    }
+  }
+}
+
+template <typename GhT, typename AccT>
+ffi::Error HistPermImpl(ffi::AnyBuffer bins, ffi::AnyBuffer gh,
+                        ffi::AnyBuffer perm, ffi::AnyBuffer leaf_begin,
+                        ffi::AnyBuffer leaf_cnt, ffi::AnyBuffer leaf_ids,
+                        bool bf16_round,
+                        ffi::Result<ffi::AnyBuffer> out) {
+  auto bdims = bins.dimensions();
+  auto odims = out->dimensions();
+  if (bdims.size() != 2 || odims.size() != 4)
+    return ffi::Error::InvalidArgument("hist_perm: bad ranks");
+  const int64_t R = bdims[0];
+  const int64_t F = bdims[1];
+  const int64_t S = odims[0];
+  const int64_t B = odims[2];
+  if (odims[1] != F || odims[3] != 3 || leaf_ids.element_count() != S)
+    return ffi::Error::InvalidArgument("hist_perm: bad output shape");
+  if (perm.element_count() != R || gh.element_count() != R * 3)
+    return ffi::Error::InvalidArgument("hist_perm: R mismatch");
+  const int64_t n_slots = leaf_begin.element_count();
+  if (leaf_cnt.element_count() != n_slots)
+    return ffi::Error::InvalidArgument("hist_perm: slot mismatch");
+
+  const int32_t* pm = reinterpret_cast<const int32_t*>(perm.untyped_data());
+  const int32_t* bg = reinterpret_cast<const int32_t*>(leaf_begin.untyped_data());
+  const int32_t* ct = reinterpret_cast<const int32_t*>(leaf_cnt.untyped_data());
+  const int32_t* lid = reinterpret_cast<const int32_t*>(leaf_ids.untyped_data());
+  const GhT* ghp = reinterpret_cast<const GhT*>(gh.untyped_data());
+  AccT* op = reinterpret_cast<AccT*>(out->untyped_data());
+  std::memset(op, 0, static_cast<size_t>(S * F * B * 3) * sizeof(AccT));
+
+  const bool u8 = bins.element_type() == ffi::U8;
+  const void* bp = bins.untyped_data();
+  if (u8) {
+    if (bf16_round)
+      hist_perm_core<uint8_t, GhT, AccT, true>(
+          reinterpret_cast<const uint8_t*>(bp), ghp, pm, bg, ct, n_slots,
+          lid, S, R, F, B, op);
+    else
+      hist_perm_core<uint8_t, GhT, AccT, false>(
+          reinterpret_cast<const uint8_t*>(bp), ghp, pm, bg, ct, n_slots,
+          lid, S, R, F, B, op);
+  } else {
+    if (bf16_round)
+      hist_perm_core<int32_t, GhT, AccT, true>(
+          reinterpret_cast<const int32_t*>(bp), ghp, pm, bg, ct, n_slots,
+          lid, S, R, F, B, op);
+    else
+      hist_perm_core<int32_t, GhT, AccT, false>(
+          reinterpret_cast<const int32_t*>(bp), ghp, pm, bg, ct, n_slots,
+          lid, S, R, F, B, op);
+  }
+  return ffi::Error::Success();
+}
+
+ffi::Error HistPermF32Impl(ffi::AnyBuffer bins, ffi::AnyBuffer gh,
+                           ffi::AnyBuffer perm, ffi::AnyBuffer leaf_begin,
+                           ffi::AnyBuffer leaf_cnt,
+                           ffi::AnyBuffer leaf_ids, bool bf16_round,
+                           ffi::Result<ffi::AnyBuffer> out) {
+  return HistPermImpl<float, float>(bins, gh, perm, leaf_begin, leaf_cnt,
+                                    leaf_ids, bf16_round, out);
+}
+
+ffi::Error HistPermI8Impl(ffi::AnyBuffer bins, ffi::AnyBuffer gh,
+                          ffi::AnyBuffer perm, ffi::AnyBuffer leaf_begin,
+                          ffi::AnyBuffer leaf_cnt,
+                          ffi::AnyBuffer leaf_ids, bool bf16_round,
+                          ffi::Result<ffi::AnyBuffer> out) {
+  (void)bf16_round;
+  return HistPermImpl<int8_t, int32_t>(bins, gh, perm, leaf_begin,
+                                       leaf_cnt, leaf_ids, false, out);
+}
+
+}  // namespace
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    LgbtpuPartition, PartitionImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()            // bins
+        .Arg<ffi::AnyBuffer>()            // row_leaf
+        .Arg<ffi::AnyBuffer>()            // perm
+        .Arg<ffi::AnyBuffer>()            // leaf_begin
+        .Arg<ffi::AnyBuffer>()            // leaf_cnt
+        .Arg<ffi::AnyBuffer>()            // pend_active
+        .Arg<ffi::AnyBuffer>()            // pend_feat
+        .Arg<ffi::AnyBuffer>()            // pend_thr
+        .Arg<ffi::AnyBuffer>()            // pend_dl
+        .Arg<ffi::AnyBuffer>()            // pend_cat
+        .Arg<ffi::AnyBuffer>()            // pend_right
+        .Arg<ffi::AnyBuffer>()            // pend_bits
+        .Arg<ffi::AnyBuffer>()            // nan_bin_pf
+        .Attr<bool>("col_major")
+        .Ret<ffi::AnyBuffer>()            // row_leaf out
+        .Ret<ffi::AnyBuffer>()            // perm out
+        .Ret<ffi::AnyBuffer>()            // leaf_begin out
+        .Ret<ffi::AnyBuffer>());          // leaf_cnt out
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    LgbtpuHistPermF32, HistPermF32Impl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()            // bins
+        .Arg<ffi::AnyBuffer>()            // gh
+        .Arg<ffi::AnyBuffer>()            // perm
+        .Arg<ffi::AnyBuffer>()            // leaf_begin
+        .Arg<ffi::AnyBuffer>()            // leaf_cnt
+        .Arg<ffi::AnyBuffer>()            // leaf_ids
+        .Attr<bool>("bf16_round")
+        .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    LgbtpuHistPermI8, HistPermI8Impl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()            // bins
+        .Arg<ffi::AnyBuffer>()            // gh
+        .Arg<ffi::AnyBuffer>()            // perm
+        .Arg<ffi::AnyBuffer>()            // leaf_begin
+        .Arg<ffi::AnyBuffer>()            // leaf_cnt
+        .Arg<ffi::AnyBuffer>()            // leaf_ids
+        .Attr<bool>("bf16_round")
+        .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    LgbtpuRelabel, RelabelImpl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()            // bins
+        .Arg<ffi::AnyBuffer>()            // row_leaf
+        .Arg<ffi::AnyBuffer>()            // pend_active
+        .Arg<ffi::AnyBuffer>()            // pend_feat
+        .Arg<ffi::AnyBuffer>()            // pend_thr
+        .Arg<ffi::AnyBuffer>()            // pend_dl
+        .Arg<ffi::AnyBuffer>()            // pend_cat
+        .Arg<ffi::AnyBuffer>()            // pend_right
+        .Arg<ffi::AnyBuffer>()            // pend_bits
+        .Arg<ffi::AnyBuffer>()            // nan_bin_pf
+        .Attr<bool>("col_major")
+        .Ret<ffi::AnyBuffer>());
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    LgbtpuHistF32, HistF32Impl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()            // bins
+        .Arg<ffi::AnyBuffer>()            // gh
+        .Arg<ffi::AnyBuffer>()            // row_leaf
+        .Arg<ffi::AnyBuffer>()            // leaf_ids
+        .Arg<ffi::AnyBuffer>()            // row_gather
+        .Arg<ffi::AnyBuffer>()            // num_rows
+        .Attr<bool>("bf16_round")
+        .Attr<bool>("use_gather")
+        .Ret<ffi::AnyBuffer>());          // out
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    LgbtpuHistI8, HistI8Impl,
+    ffi::Ffi::Bind()
+        .Arg<ffi::AnyBuffer>()
+        .Arg<ffi::AnyBuffer>()
+        .Arg<ffi::AnyBuffer>()
+        .Arg<ffi::AnyBuffer>()
+        .Arg<ffi::AnyBuffer>()
+        .Arg<ffi::AnyBuffer>()
+        .Attr<bool>("bf16_round")
+        .Attr<bool>("use_gather")
+        .Ret<ffi::AnyBuffer>());

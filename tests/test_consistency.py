@@ -159,3 +159,31 @@ def test_binary_conf_hist_dtypes_agree():
     # hold is that bf16 costs no systematic accuracy (either can win the
     # coin-flip by a couple of ndcg points of auc)
     assert abs(auc_bf16 - auc_f32) < 0.02, (auc_bf16, auc_f32)
+
+
+@pytest.mark.slow
+def test_leaf_batch_auc_delta_bounded():
+    """VERDICT r4 #6: leaf_batch>1 changes split ORDER (the one
+    TPU-first liberty without a measured bound); quantify it. At a
+    Higgs-like shape the valid-AUC spread across leaf_batch in
+    {1, 4, 16} must stay within noise (<0.003 at this scale; bench.py
+    records the 1M-row spread every run)."""
+    rng = np.random.RandomState(11)
+    n, f = 200_000, 20
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=f) / np.sqrt(f)
+    logit = X @ w + 0.6 * X[:, 0] * X[:, 1] - 0.3 * X[:, 2] ** 2
+    y = (logit + rng.logistic(size=n) * 0.5 > 0).astype(np.float32)
+    Xt, yt, Xv, yv = X[:160_000], y[:160_000], X[160_000:], y[160_000:]
+    aucs = {}
+    for lb in (1, 4, 16):
+        train = lgb.Dataset(Xt, label=yt, params={"max_bin": 63})
+        valid = lgb.Dataset(Xv, label=yv, reference=train)
+        bst = lgb.train({"objective": "binary", "metric": "auc",
+                         "num_leaves": 127, "leaf_batch": lb,
+                         "max_bin": 63, "min_data_in_leaf": 50,
+                         "verbosity": -1}, train, 15,
+                        valid_sets=[valid], valid_names=["v"])
+        aucs[lb] = float(bst.eval_valid()[0][2])
+    spread = max(aucs.values()) - min(aucs.values())
+    assert spread < 0.003, f"leaf_batch AUC spread {spread:.5f}: {aucs}"

@@ -128,6 +128,41 @@ _WORKER = textwrap.dedent("""
         sl = slice(0, n)
         ds = lgb.Dataset(X, label=y)
         params = {}
+    if mode == "ranking":
+        # lambdarank across hosts (VERDICT r4 #4): each worker owns
+        # WHOLE queries (the reference pre-partitions by query);
+        # gradients are per-process, histogram sync is global
+        rngq = np.random.RandomState(7)
+        nq = 120
+        sizes = rngq.randint(5, 20, size=nq)
+        bounds = np.concatenate([[0], np.cumsum(sizes)])
+        nr = int(bounds[-1])
+        Xq = rngq.normal(size=(nr, 6))
+        rel = (Xq[:, 0] + 0.6 * Xq[:, 1]
+               + rngq.normal(scale=0.6, size=nr))
+        yq = np.zeros(nr)
+        for q in range(nq):
+            r = rel[bounds[q]:bounds[q + 1]]
+            yq[bounds[q]:bounds[q + 1]] = np.clip(
+                np.searchsorted(np.sort(r), r) * 4 // max(1, len(r)),
+                0, 3)
+        qcut = 60
+        qs = slice(0, qcut) if rank == 0 else slice(qcut, nq)
+        rs = slice(int(bounds[qs.start]), int(bounds[qs.stop]))
+        ds = lgb.Dataset(Xq[rs], label=yq[rs], group=sizes[qs],
+                         params={"pre_partition": True})
+        bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                         "eval_at": [5], "num_leaves": 15,
+                         "tree_learner": "data", "min_data_in_leaf": 5,
+                         "pre_partition": True, "verbosity": -1},
+                        ds, num_boost_round=10)
+        txt = bst.model_to_string()
+        ndcg = float(bst.eval_train()[0][2])
+        with open(os.path.join(outdir, f"out_{rank}.json"), "w") as f:
+            json.dump({"ndcg": ndcg}, f)
+        with open(os.path.join(outdir, f"model_{rank}.txt"), "w") as f:
+            f.write(txt)
+        sys.exit(0)
     bst = lgb.train({"objective": "binary", "num_leaves": 15,
                      "tree_learner": "data",
                      "min_data_in_leaf": 5, "verbosity": -1, **params},
@@ -187,6 +222,62 @@ def test_two_process_data_parallel_training(tmp_path):
 @pytest.mark.slow
 def test_two_process_auto_partition_training(tmp_path):
     _run_two_workers(tmp_path, "auto")
+
+
+@pytest.mark.slow
+def test_two_process_lambdarank_matches_single_process(tmp_path):
+    """VERDICT r4 #4: distributed lambdarank. Both workers must emit
+    the identical model, and its quality must match a single-process
+    run on the same data (NDCG@5 within binning-sync tolerance)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), port, str(tmp_path), repo,
+         "ranking"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    try:
+        outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    m0 = (tmp_path / "model_0.txt").read_text()
+    m1 = (tmp_path / "model_1.txt").read_text()
+    assert m0 == m1, "workers must produce the identical model"
+    # single-process run over the SAME generated data (worker rngq=7)
+    rngq = np.random.RandomState(7)
+    nq = 120
+    sizes = rngq.randint(5, 20, size=nq)
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    nr = int(bounds[-1])
+    Xq = rngq.normal(size=(nr, 6))
+    rel = Xq[:, 0] + 0.6 * Xq[:, 1] + rngq.normal(scale=0.6, size=nr)
+    yq = np.zeros(nr)
+    for q in range(nq):
+        r = rel[bounds[q]:bounds[q + 1]]
+        yq[bounds[q]:bounds[q + 1]] = np.clip(
+            np.searchsorted(np.sort(r), r) * 4 // max(1, len(r)), 0, 3)
+    import lightgbm_tpu as lgb
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                     "eval_at": [5], "num_leaves": 15,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(Xq, label=yq, group=sizes), 10)
+    ndcg_sp = float(bst.eval_train()[0][2])
+    nd0 = json.loads((tmp_path / "out_0.json").read_text())["ndcg"]
+    nd1 = json.loads((tmp_path / "out_1.json").read_text())["ndcg"]
+    # per-host NDCG over each host's own queries; the mean stands in
+    # for the global number (equal-ish query counts)
+    ndcg_mp = 0.5 * (nd0 + nd1)
+    assert ndcg_sp > 0.7, ndcg_sp
+    assert abs(ndcg_mp - ndcg_sp) < 0.05, (ndcg_mp, ndcg_sp, nd0, nd1)
 
 
 _LAUNCH_WORKER = textwrap.dedent("""

@@ -123,6 +123,89 @@ def test_pallas_interpret_matches_oracle(rng):
     np.testing.assert_allclose(pls, xla, rtol=1e-5, atol=1e-5)
 
 
+def test_pallas_dynamic_row_bound_skips_blocks(rng):
+    """VERDICT r4 #3: with ``num_rows`` the kernel must never touch row
+    blocks past ``ceil(num_rows / blk)``. Rows past the bound are
+    POISONED — live leaf ids with huge gradients — so if any skipped
+    block were processed the histogram would be visibly corrupt. (The
+    trailing partial block is covered separately: inside it, rows past
+    num_rows carry row_leaf == -1 per the caller contract.)"""
+    from lightgbm_tpu.ops import pallas_histogram as PH
+    F, B, L = 4, 16, 3
+    blk = PH._plan_chunks(F, B, L)[0]
+    R = 3 * blk                       # three full blocks
+    n_live = blk + 7                  # block 0 full + 7 rows of block 1
+    bins = rng.randint(0, B, size=(R, F)).astype(np.uint8)
+    gh = np.stack([rng.normal(size=R), rng.uniform(0.1, 1, size=R),
+                   np.ones(R)], 1).astype(np.float32)
+    row_leaf = rng.randint(0, L, size=R).astype(np.int32)
+    # caller contract: within the trailing partial block, rows past
+    # num_rows are dead
+    row_leaf_in = row_leaf.copy()
+    row_leaf_in[n_live:2 * blk] = -1
+    # poison: block 2 is ENTIRELY past the bound and stays live+huge —
+    # only the grid bound (not the leaf mask) protects against it
+    gh_in = gh.copy()
+    gh_in[2 * blk:] = 1e9
+    leaf_ids = np.arange(L, dtype=np.int32)
+    got = np.asarray(PH.build_histograms_pallas(
+        jnp.asarray(bins), jnp.asarray(gh_in), jnp.asarray(row_leaf_in),
+        jnp.asarray(leaf_ids), num_bins=B, hist_dtype="float32",
+        interpret=True, num_rows=jnp.asarray(n_live, jnp.int32)))
+    want = build_histograms_reference(
+        bins[:n_live], gh[:n_live], row_leaf[:n_live], leaf_ids, B)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # num_rows=0: empty histogram, accumulator still initialized
+    got0 = np.asarray(PH.build_histograms_pallas(
+        jnp.asarray(bins), jnp.asarray(gh_in),
+        jnp.asarray(np.full(R, -1, np.int32)),
+        jnp.asarray(leaf_ids), num_bins=B, hist_dtype="float32",
+        interpret=True, num_rows=jnp.asarray(0, jnp.int32)))
+    assert (got0 == 0).all()
+
+
+def test_pallas_tree_with_subtraction_matches_scatter(rng, monkeypatch):
+    """The full training path hist_impl=pallas + hist_subtraction runs
+    the kernel over the COMPACTED dynamic row stream (row_gather +
+    num_rows — VERDICT r4 #3's reachability: the same call
+    tree_builder makes on TPU, here through the interpreter). Must grow
+    the scatter tree."""
+    import functools as ft
+    from lightgbm_tpu.ops import histogram as H
+    from lightgbm_tpu.ops import pallas_histogram as PH
+    from lightgbm_tpu.boosting.tree_builder import build_tree
+    from lightgbm_tpu.ops.split import SplitParams
+    orig = PH.build_histograms_pallas
+    monkeypatch.setattr(PH, "build_histograms_pallas",
+                        ft.partial(orig, interpret=True))
+    R, F, B = 1024, 6, 16
+    bins = rng.randint(0, B, size=(R, F)).astype(np.uint8)
+    y = rng.normal(size=R)
+    g = (y - y.mean()).astype(np.float32)
+    gh = np.stack([g, np.ones(R, np.float32),
+                   np.ones(R, np.float32)], axis=1)
+    meta = dict(
+        num_bins_pf=jnp.full((F,), B, jnp.int32),
+        nan_bin_pf=jnp.full((F,), -1, jnp.int32),
+        is_cat_pf=jnp.zeros((F,), bool),
+        feature_mask=jnp.ones((F,), bool))
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3)
+    out = {}
+    for impl in ("pallas", "scatter"):
+        t, rl, _ = build_tree(
+            jnp.asarray(bins), jnp.asarray(gh),
+            jnp.zeros((R,), jnp.int32), meta["num_bins_pf"],
+            meta["nan_bin_pf"], meta["is_cat_pf"], meta["feature_mask"],
+            num_leaves=15, leaf_batch=2, max_depth=-1, num_bins=B,
+            split_params=sp, hist_dtype="float32", hist_impl=impl,
+            block_rows=256, hist_sub=True)
+        out[impl] = (np.asarray(t.split_feature),
+                     np.asarray(t.threshold_bin), np.asarray(rl))
+    np.testing.assert_array_equal(out["pallas"][0], out["scatter"][0])
+    np.testing.assert_array_equal(out["pallas"][1], out["scatter"][1])
+    np.testing.assert_array_equal(out["pallas"][2], out["scatter"][2])
+
+
 def test_auto_impl_pallas_fallback(monkeypatch):
     """hist_impl='auto' on TPU must survive a Mosaic rejection of the
     Pallas kernel: the probe fails once, logs, and resolves to matmul
@@ -168,10 +251,127 @@ def test_auto_impl_pallas_accepted(monkeypatch):
         H._reset_pallas_probe()
 
 
-def test_auto_impl_cpu_is_scatter(monkeypatch):
+def test_auto_impl_cpu_prefers_native(monkeypatch):
+    """auto on CPU: the runtime-compiled C kernel when a toolchain
+    exists, XLA scatter otherwise."""
+    from lightgbm_tpu import native as N
     from lightgbm_tpu.ops import histogram as H
     monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    want = "native" if N.hist_lib() is not None else "scatter"
+    assert H.resolve_impl("auto") == want
+    monkeypatch.setattr(N, "hist_lib", lambda: None)
     assert H.resolve_impl("auto") == "scatter"
+
+
+def test_native_matches_scatter(rng):
+    """The C histogram kernel (native/hist.c) is bit-identical to the
+    XLA scatter path: same skip rules, same bf16 addend rounding, exact
+    int32 accumulation when quantized, and the compacted dynamic row
+    stream (row_gather + num_rows) honored."""
+    pytest.importorskip("ctypes")
+    from lightgbm_tpu import native as N
+    if N.hist_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    bins, gh, row_leaf, leaf_ids = _case(rng, R=700, F=7, B=13, L=4)
+    kw = dict(num_bins=13, block_rows=0)
+    for dt in ("float32", "bfloat16"):
+        a = np.asarray(build_histograms(
+            jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(row_leaf),
+            jnp.asarray(leaf_ids), hist_dtype=dt, impl="native", **kw))
+        b = np.asarray(build_histograms(
+            jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(row_leaf),
+            jnp.asarray(leaf_ids), hist_dtype=dt, impl="scatter", **kw))
+        np.testing.assert_array_equal(a, b)
+    # quantized: int8 addends accumulate exactly into int32
+    gh8 = np.random.RandomState(5).randint(
+        -100, 100, size=gh.shape).astype(np.int8)
+    gh8[row_leaf < 0] = 0
+    a = np.asarray(build_histograms(
+        jnp.asarray(bins), jnp.asarray(gh8), jnp.asarray(row_leaf),
+        jnp.asarray(leaf_ids), impl="native", **kw))
+    b = np.asarray(build_histograms(
+        jnp.asarray(bins), jnp.asarray(gh8), jnp.asarray(row_leaf),
+        jnp.asarray(leaf_ids), impl="scatter", **kw))
+    assert a.dtype == np.int32
+    np.testing.assert_array_equal(a, b)
+    # compacted dynamic row stream: only leaf 1's rows are streamed
+    R = len(row_leaf)
+    m = row_leaf == 1
+    n_small = int(m.sum())
+    pos = np.cumsum(m) - 1
+    c_idx = np.zeros(R, np.int32)
+    c_idx[pos[m]] = np.arange(R, dtype=np.int32)[m]
+    rl_c = np.where(np.arange(R) < n_small, row_leaf[c_idx],
+                    -1).astype(np.int32)
+    got = np.asarray(build_histograms(
+        jnp.asarray(bins), jnp.asarray(gh[c_idx]), jnp.asarray(rl_c),
+        jnp.asarray(leaf_ids), hist_dtype="float32", impl="native",
+        row_gather=jnp.asarray(c_idx),
+        num_rows=jnp.asarray(n_small, jnp.int32), **kw))
+    want = build_histograms_reference(bins, gh, row_leaf, leaf_ids, 13)
+    np.testing.assert_allclose(got[1], want[1], rtol=1e-5, atol=1e-4)
+    assert (got[0] == 0).all() and (got[2] == 0).all()
+
+
+def test_native_tree_matches_scatter_tree(rng):
+    """Growing a whole tree with hist_impl=native (the FFI partition +
+    perm-histogram path, incl. the column-major bins copy) reproduces
+    the scatter tree bit-for-bit in routing: same splits, same row
+    partition, matching leaf values. Covers NaN-bin routing, a
+    categorical bitset split, padded rows and zeroed-gh (bagged) rows."""
+    from lightgbm_tpu import native as N
+    if N.hist_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    from lightgbm_tpu.boosting.tree_builder import build_tree
+    from lightgbm_tpu.ops.split import SplitParams
+    R, F, B, pad = 2048, 8, 32, 64
+    bins = rng.randint(0, B - 1, size=(R, F)).astype(np.uint8)
+    # feature 2 carries a NaN bin (last); ~10% of its rows are missing
+    bins[rng.rand(R) < 0.1, 2] = B - 1
+    # feature 5 is categorical
+    y = rng.normal(size=R) + (bins[:, 5] % 3 == 0) * 2.0 \
+        + (bins[:, 2] == B - 1) * 1.5
+    g = (y - y.mean()).astype(np.float32)
+    gh = np.stack([g, np.ones(R, np.float32),
+                   np.ones(R, np.float32)], axis=1)
+    gh[rng.rand(R) < 0.2] = 0.0          # "bagged-out" rows
+    bins = np.concatenate([bins, np.zeros((pad, F), np.uint8)])
+    gh = np.concatenate([gh, np.zeros((pad, 3), np.float32)])
+    rl0 = np.concatenate([np.zeros(R, np.int32),
+                          np.full(pad, -1, np.int32)])
+    nan_bin = np.full((F,), -1, np.int32)
+    nan_bin[2] = B - 1
+    is_cat = np.zeros((F,), bool)
+    is_cat[5] = True
+    meta = dict(
+        num_bins_pf=jnp.full((F,), B, jnp.int32),
+        nan_bin_pf=jnp.asarray(nan_bin),
+        is_cat_pf=jnp.asarray(is_cat),
+        feature_mask=jnp.ones((F,), bool))
+    sp = SplitParams(min_data_in_leaf=5, min_sum_hessian_in_leaf=1e-3,
+                     cat_smooth=10.0, cat_l2=10.0)
+    out = {}
+    for impl in ("native", "scatter"):
+        kw = {}
+        if impl == "native":
+            kw["bins_cm"] = jnp.asarray(bins.T)
+        t, rl, _ = build_tree(
+            jnp.asarray(bins), jnp.asarray(gh),
+            jnp.asarray(rl0), meta["num_bins_pf"],
+            meta["nan_bin_pf"], meta["is_cat_pf"], meta["feature_mask"],
+            num_leaves=31, leaf_batch=4, max_depth=-1, num_bins=B,
+            split_params=sp, hist_dtype="float32", hist_impl=impl,
+            block_rows=256, hist_sub=True, **kw)
+        out[impl] = (np.asarray(t.split_feature),
+                     np.asarray(t.threshold_bin),
+                     np.asarray(t.leaf_values), np.asarray(rl),
+                     np.asarray(t.is_cat).sum())
+    np.testing.assert_array_equal(out["native"][0], out["scatter"][0])
+    np.testing.assert_array_equal(out["native"][1], out["scatter"][1])
+    np.testing.assert_allclose(out["native"][2], out["scatter"][2],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(out["native"][3], out["scatter"][3])
+    assert out["native"][4] > 0, "test should exercise a categorical split"
 
 
 def test_subtraction_tree_matches_direct(rng):
