@@ -212,9 +212,7 @@ def probe_hist_impl(platform: str) -> dict:
     # roofline context for the chosen kernel on EVERY platform (reuse
     # the timing already measured above when one exists)
     try:
-        prior_ms = out.get("hist_pallas_ms"
-                           if out["hist_impl"] == "pallas"
-                           else "hist_matmul_ms")
+        prior_ms = out.get(f"hist_{out['hist_impl']}_ms")
         t_chosen = (prior_ms / 1e3 if prior_ms
                     else bench_one(out["hist_impl"]))
         out["hist_ms"] = round(t_chosen * 1e3, 2)

@@ -200,9 +200,14 @@ class GBDT:
         # capacity gate BEFORE the device transfer (VERDICT r4 #5):
         # fail with sized guidance, not a mid-training device OOM
         from ..dataset import check_device_capacity
-        n_row_shards = (jax.device_count()
-                        if self.plan is not None and self.plan.rows_sharded
-                        else 1)
+        # multi-process: num_data is this process's LOCAL rows and they
+        # spread over the process's own devices only — dividing by the
+        # GLOBAL device count would understate the per-chip footprint
+        if self.plan is not None and self.plan.rows_sharded:
+            n_row_shards = max(1, self.plan.num_shards
+                               // getattr(self.plan, "num_processes", 1))
+        else:
+            n_row_shards = 1
         check_device_capacity(
             self.train_set.num_data, self.train_set.bins.shape[1],
             self.train_set.bins.dtype.itemsize, config.num_leaves,
@@ -220,11 +225,14 @@ class GBDT:
         lbl = self.train_set.get_label()
         self._mp = bool(self.plan is not None
                         and getattr(self.plan, "multi_process", False))
-        if self._mp and (bool(config.linear_tree)
-                         or init_row_scores is not None):
+        if self._mp and bool(config.linear_tree):
+            # reference parity: "linear tree learner must be serial
+            # type" (config.cpp:429-437 forces tree_learner=serial), so
+            # distributed linear trees do not exist there either
             raise NotImplementedError(
-                "multi-host training does not yet support linear_tree "
-                "or init_model continuation")
+                "linear_tree requires single-host training (the "
+                "reference forces tree_learner=serial for linear trees "
+                "too, config.cpp:429)")
         # multi-host ranking (VERDICT r4 #4): the padded-query lattice
         # holds LOCAL row ids, so ranking gradients are computed PER
         # PROCESS on the host's own score block (each host owns whole
@@ -283,18 +291,26 @@ class GBDT:
                 self._init_scores = global_mean_init_scores(
                     self._init_scores)
 
+        def _put_scores(local_kr):
+            return (self.plan.shard_scores(local_kr)
+                    if self.plan is not None
+                    else jnp.asarray(local_kr))
+
         if init_row_scores is not None:
             # continued training (init_model): scores resume from the
             # loaded model's per-row predictions; no BoostFromAverage
-            # (gbdt.cpp only boosts from average when models_.empty())
-            def to_kr(a, r_pad):
+            # (gbdt.cpp only boosts from average when models_.empty()).
+            # Multi-host: each host predicted its own pre-partitioned
+            # rows with the base model, so the [K, R_loc] block shards
+            # into the global score array like any other score field.
+            def to_kr(a, r_loc):
                 a = np.asarray(a, np.float32)
                 if a.ndim == 1:
                     a = a[:, None]
-                return _pad_rows(a, r_pad).T  # [K, R]
-            self.scores = jnp.asarray(to_kr(init_row_scores, R))
+                return _pad_rows(a, r_loc).T  # [K, R_loc]
+            self.scores = _put_scores(to_kr(init_row_scores, R_loc))
             self.valid_scores = [
-                jnp.asarray(to_kr(v, dd.r_pad))
+                _put_scores(to_kr(v, dd.r_local))
                 for v, dd in zip(valid_init_row_scores, self.valid_dd)]
             self._init_scores = np.zeros(self.K)
         # NOTE: when init_row_scores (init_model) is present it takes
@@ -309,10 +325,6 @@ class GBDT:
             # prediction excludes the offset exactly like the reference.
             # Under multi-process each host's Metadata holds its LOCAL
             # rows; the local block is placed into the sharded array.
-            def _put_scores(local_kr):
-                return (self.plan.shard_scores(local_kr)
-                        if self.plan is not None
-                        else jnp.asarray(local_kr))
             self.scores = _put_scores(self._field_init_scores(
                 self.train_set.get_init_score(), self.train_set.num_data,
                 self.train_dd.r_local))
@@ -762,9 +774,10 @@ class GBDT:
                               self._cegb_feat_used, self._cegb_used_rows)
         if (self.plan is None and self._bundle_meta is None
                 and resolve_impl(cfg.hist_impl) == "native"):
-            # column-major copy of the bin matrix for the native relabel
-            # custom call (dense_bin.hpp stores per-feature columns for
-            # the same reason); built once, reused every tree
+            # column-major copy of the bin matrix for the native
+            # PARTITION custom call (dense_bin.hpp stores per-feature
+            # columns for the same reason: the split feature's column is
+            # read contiguously); built once, reused every tree
             if self._bins_cm is None:
                 self._bins_cm = jnp.asarray(self.train_dd.bins.T)
             kw["bins_cm"] = self._bins_cm
@@ -800,13 +813,17 @@ class GBDT:
         return out
 
     def _parse_forced_splits(self, path):
-        """JSON forced-split tree -> (parents, isright, feats, thrs)
-        static tuples in BFS order (ForceSplits queue semantics). Each
-        node records its parent's index in the list (-1 for the root)
-        and which side it forces — slots resolve at runtime inside the
-        builder so a dropped forced node drops its subtree. Feature
-        indices are ORIGINAL column ids; thresholds are raw values
-        mapped through the feature's BinMapper."""
+        """JSON forced-split tree -> (parents, isright, feats, thrs,
+        is_cat) static tuples in BFS order (ForceSplits queue
+        semantics). Each node records its parent's index in the list
+        (-1 for the root) and which side it forces — slots resolve at
+        runtime inside the builder so a dropped forced node drops its
+        subtree. Feature indices are ORIGINAL column ids; thresholds
+        are raw values mapped through the feature's BinMapper. A
+        categorical node forces the one-hot split on its category
+        (GatherInfoForThresholdCategoricalInner,
+        feature_histogram.hpp:604: left = rows equal to the category,
+        default_left=false)."""
         import json as _json
         from collections import deque
         with open(path) as fh:
@@ -815,7 +832,7 @@ class GBDT:
             raise NotImplementedError(
                 "forced splits support the serial/data tree learners")
         uf = list(self.train_set.used_features)
-        parents, isright, feats, thrs = [], [], [], []
+        parents, isright, feats, thrs, iscat = [], [], [], [], []
         q = deque([(root, -1, False)])
         while q:
             node, pj, is_r = q.popleft()
@@ -829,22 +846,35 @@ class GBDT:
             f_inner = uf.index(f_orig)
             m = self.train_set.bin_mappers[f_orig]
             if m.bin_type == "categorical":
-                raise NotImplementedError(
-                    "forced splits on categorical features are not "
-                    "supported")
-            thr_bin = int(m.values_to_bins(
-                np.asarray([float(node["threshold"])]))[0])
+                # reference: ValueToBin of an unseen/negative category
+                # returns the reserved bin and the gather rejects it
+                # ("Invalid categorical threshold split",
+                # feature_histogram.hpp:613). Our bin 0 is the most
+                # frequent REAL category, so the miss must be caught
+                # here: thr_bin=-1 makes the builder drop the node.
+                cv = int(float(node["threshold"]))
+                thr_bin = m._cat_to_bin.get(cv, -1) if cv >= 0 else -1
+                if thr_bin < 0:
+                    from .. import log as _log
+                    _log.warning(
+                        "Invalid categorical threshold split: category "
+                        f"{cv} of feature {f_orig} was not seen in "
+                        "training; the forced node will be skipped")
+            else:
+                thr_bin = int(m.values_to_bins(
+                    np.asarray([float(node["threshold"])]))[0])
             me = len(parents)
             parents.append(pj)
             isright.append(is_r)
             feats.append(f_inner)
             thrs.append(thr_bin)
+            iscat.append(m.bin_type == "categorical")
             if node.get("left"):
                 q.append((node["left"], me, False))
             if node.get("right"):
                 q.append((node["right"], me, True))
         return (tuple(parents), tuple(isright), tuple(feats),
-                tuple(thrs))
+                tuple(thrs), tuple(iscat))
 
     def _quantize_impl(self, g, h, key):
         """Stochastic rounding onto the int8 quant grid

@@ -318,6 +318,9 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         f_isright_a = jnp.asarray(forced[1], bool)
         f_feats_a = jnp.asarray(forced[2], jnp.int32)
         f_thrs_a = jnp.asarray(forced[3], jnp.int32)
+        # categorical forced nodes: one-hot on the category's bin;
+        # thr=-1 marks an invalid (unseen) category the round must drop
+        f_iscat_a = jnp.asarray(forced[4], bool)
         n_forced = len(forced[0])
     use_inter = interaction_groups is not None
     use_bynode = feature_fraction_bynode < 1.0
@@ -911,6 +914,7 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                     lambda: jnp.zeros((2 * W, F, B, HIST_CH),
                                       jnp.float32))[0]
             hrow = jnp.take(hist_fc0, f_feat, axis=0)         # [B, 3]
+            f_cat = jnp.take(f_iscat_a, fr)
             nb_f = jnp.take(nan_bin_pf, f_feat)
             # GatherInfoForThresholdNumericalInner accumulates the RIGHT
             # side from the top bin down to threshold+1, SKIPPING the
@@ -927,8 +931,15 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 nb_f >= 0,
                 jnp.take(hrow, jnp.clip(nb_f, 0, B - 1), axis=0),
                 jnp.zeros((HIST_CH,), jnp.float32))
-            lsum = (jnp.take(cum, jnp.clip(f_thr, 0, B - 1), axis=0)
-                    + nan_row)
+            lsum_num = (jnp.take(cum, jnp.clip(f_thr, 0, B - 1), axis=0)
+                        + nan_row)
+            # categorical: one-hot — left = the category's own bin only
+            # (GatherInfoForThresholdCategoricalInner,
+            # feature_histogram.hpp:604); thr=-1 (unseen category) is
+            # rejected below in ok_f, matching the reference's
+            # "Invalid categorical threshold" rejection (hpp:613)
+            lsum_cat = jnp.take(hrow, jnp.clip(f_thr, 0, B - 1), axis=0)
+            lsum = jnp.where(f_cat, lsum_cat, lsum_num)
             rsum = tot - lsum
             l1_, l2_ = sp.lambda_l1, sp.lambda_l2
             node_of_f = jnp.take(t.leaf2node,
@@ -952,6 +963,7 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                       - sp.min_gain_to_split)
             depth_f = jnp.take(st["leaf_depth"], jnp.clip(f_slot, 0, L))
             ok_f = (in_forced & parent_ok
+                    & (~f_cat | (f_thr >= 0))   # unseen category: drop
                     & (lsum[2] >= sp.min_data_in_leaf)
                     & (rsum[2] >= sp.min_data_in_leaf)
                     & (lsum[1] >= sp.min_sum_hessian_in_leaf)
@@ -984,14 +996,19 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                                DUMMY_NODE)
             sfeat = _ov(sfeat, f_feat)
             sthr = _ov(sthr, f_thr)
-            sdl = _ov(sdl, True)   # forced numerical: missing left
-            scat = _ov(scat, False)
+            # numerical: missing left; categorical: default_left=false
+            # (hpp:606) — cat routing is bitset membership anyway
+            sdl = _ov(sdl, ~f_cat)
+            scat = _ov(scat, f_cat)
             sgain = _ov(sgain, f_gain)
             slsum = slsum.at[0].set(jnp.where(ok_f, lsum, slsum[0]))
             srsum = srsum.at[0].set(jnp.where(ok_f, rsum, srsum[0]))
-            sbits = sbits.at[0].set(jnp.where(ok_f,
-                                              jnp.zeros((BW,), jnp.uint32),
-                                              sbits[0]))
+            # categorical LEFT subset = the single forced category bin
+            f_bits = jnp.where(
+                f_cat & (jnp.arange(BW, dtype=jnp.int32) == (f_thr >> 5)),
+                jnp.uint32(1) << (f_thr & 31).astype(jnp.uint32),
+                jnp.uint32(0))
+            sbits = sbits.at[0].set(jnp.where(ok_f, f_bits, sbits[0]))
             lval = _ov(lval, f_lout)
             rval = _ov(rval, f_rout)
 
@@ -1173,21 +1190,23 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         # the XLA formulation)
         use_native_relabel = hist_impl == "native" and not use_bundle
 
-        def relabel(bmat, rl, cm=None):
+        def relabel(bmat, rl):
+            # only VALID matrices reach the native relabel: the train
+            # matrix goes through lgbtpu_partition whenever the native
+            # backend is on (use_native_part == use_native_relabel)
             if use_native_relabel:
-                mat = bmat if cm is None else cm
                 # the matrix may be narrower than the padded per-feature
                 # metadata (feature-parallel pads the TRAIN matrix's
                 # feature axis; valid matrices stay unpadded)
-                F_mat = mat.shape[0] if cm is not None else mat.shape[1]
+                F_mat = bmat.shape[1]
                 out = jax.ffi.ffi_call(
                     "lgbtpu_relabel",
                     jax.ShapeDtypeStruct(rl.shape, jnp.int32))(
-                    mat, rl.astype(jnp.int32),
+                    bmat, rl.astype(jnp.int32),
                     pend_active, pend_feat, pend_thr, pend_dl, pend_cat,
                     pend_right, pend_bits,
                     nan_bin_pf[:F_mat].astype(jnp.int32),
-                    col_major=cm is not None)
+                    col_major=False)
                 if axis_name is not None:
                     out = _pvary(out, axis_name)
                 return out
@@ -1240,7 +1259,7 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             new_state_part = dict(perm=perm_n, leaf_begin=lb_n,
                                   leaf_cnt=lc_n)
         else:
-            row_leaf = relabel(bins, st["row_leaf"], cm=bins_cm)
+            row_leaf = relabel(bins, st["row_leaf"])
         valid_row_leaf = tuple(
             relabel(vb, vrl)
             for vb, vrl in zip(valid_bins, st["valid_row_leaf"]))

@@ -163,6 +163,28 @@ _WORKER = textwrap.dedent("""
         with open(os.path.join(outdir, f"model_{rank}.txt"), "w") as f:
             f.write(txt)
         sys.exit(0)
+    if mode == "init_model":
+        # continued training across hosts (VERDICT r4 #4 remainder):
+        # each host predicts its own pre-partitioned rows with the
+        # base model; scores resume sharded
+        cut = 2000
+        sl = slice(0, cut) if rank == 0 else slice(cut, n)
+        ds = lgb.Dataset(X[sl], label=y[sl],
+                         params={"pre_partition": True},
+                         free_raw_data=False)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "tree_learner": "data", "min_data_in_leaf": 5,
+                         "pre_partition": True, "verbosity": -1},
+                        ds, num_boost_round=6,
+                        init_model=os.path.join(outdir, "base.txt"))
+        txt = bst.model_to_string()
+        from sklearn.metrics import roc_auc_score
+        auc = roc_auc_score(y[sl], bst.predict(X[sl]))
+        with open(os.path.join(outdir, f"out_{rank}.json"), "w") as f:
+            json.dump({"auc": auc, "n_trees": bst.num_trees()}, f)
+        with open(os.path.join(outdir, f"model_{rank}.txt"), "w") as f:
+            f.write(txt)
+        sys.exit(0)
     bst = lgb.train({"objective": "binary", "num_leaves": 15,
                      "tree_learner": "data",
                      "min_data_in_leaf": 5, "verbosity": -1, **params},
@@ -278,6 +300,54 @@ def test_two_process_lambdarank_matches_single_process(tmp_path):
     ndcg_mp = 0.5 * (nd0 + nd1)
     assert ndcg_sp > 0.7, ndcg_sp
     assert abs(ndcg_mp - ndcg_sp) < 0.05, (ndcg_mp, ndcg_sp, nd0, nd1)
+
+
+@pytest.mark.slow
+def test_two_process_init_model_continuation(tmp_path):
+    """Continued training (init_model) across 2 processes: both workers
+    resume from the same base model over pre-partitioned shards, emit
+    the identical continued model, and improve on the base AUC."""
+    rng = np.random.RandomState(0)
+    n = 4000
+    X = rng.normal(size=(n, 6))
+    y = (X[:, 0] - 0.8 * X[:, 1] ** 2 + 0.5 * X[:, 2]
+         + rng.normal(scale=0.3, size=n) > 0).astype(float)
+    base = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "min_data_in_leaf": 5, "verbosity": -1},
+                     lgb.Dataset(X, label=y), 4)
+    base.save_model(str(tmp_path / "base.txt"))
+    from sklearn.metrics import roc_auc_score
+    base_auc = roc_auc_score(y, base.predict(X))
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), port, str(tmp_path), repo,
+         "init_model"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for r in range(2)]
+    try:
+        outs = [p.communicate(timeout=420)[0].decode() for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    m0 = (tmp_path / "model_0.txt").read_text()
+    m1 = (tmp_path / "model_1.txt").read_text()
+    assert m0 == m1, "workers must produce the identical continued model"
+    r0 = json.loads((tmp_path / "out_0.json").read_text())
+    r1 = json.loads((tmp_path / "out_1.json").read_text())
+    assert r0["n_trees"] == 10         # 4 base + 6 continued
+    # continued model must beat the base on each host's own rows
+    assert min(r0["auc"], r1["auc"]) > base_auc - 0.005, (
+        r0, r1, base_auc)
 
 
 _LAUNCH_WORKER = textwrap.dedent("""

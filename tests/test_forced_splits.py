@@ -228,3 +228,118 @@ def test_forced_missing_routes_left_matches_reference(rng, tmp_path):
     nan_rows = X[np.isnan(X[:, 2])]
     np.testing.assert_allclose(
         ours.predict(nan_rows), ref.predict(nan_rows), atol=0.35)
+
+
+def test_forced_categorical_one_hot(rng, tmp_path):
+    """Categorical forced split (GatherInfoForThresholdCategoricalInner,
+    feature_histogram.hpp:604): root forces a one-hot split on the given
+    category — left = rows equal to the category, right = everything
+    else, default_left=false."""
+    n = 2000
+    cat = rng.randint(0, 6, size=n).astype(np.float64)
+    X = np.column_stack([cat, rng.normal(size=n)])
+    y = (cat == 3) * 2.0 + 0.3 * X[:, 1] + 0.05 * rng.normal(size=n)
+    f = _forced_file(tmp_path, {"feature": 0, "threshold": 3})
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "learning_rate": 0.5,
+                     "forcedsplits_filename": f,
+                     "categorical_feature": [0]},
+                    lgb.Dataset(X, label=y, free_raw_data=False,
+                                categorical_feature=[0]), 4)
+    t = bst._all_trees()[0]
+    assert t.split_feature[0] == 0
+    assert bool(t.decision_type[0] & 1), "root must be categorical"
+    # routing: category 3 goes LEFT (in the one-category subset),
+    # everything else right — verify via leaf assignments
+    probe = np.column_stack([np.arange(6, dtype=np.float64),
+                             np.zeros(6)])
+    leaves = np.asarray(
+        bst.predict(probe, pred_leaf=True)).reshape(6, -1)[:, 0]
+
+    def in_left_subtree(leaf):
+        node = t.left_child[0]
+        if node < 0:
+            return leaf == ~node
+        stack, leaves_l = [node], set()
+        while stack:
+            nn = stack.pop()
+            for c in (t.left_child[nn], t.right_child[nn]):
+                if c >= 0:
+                    stack.append(c)
+                else:
+                    leaves_l.add(~c)
+        return leaf in leaves_l
+    sides = [in_left_subtree(int(l)) for l in leaves]
+    assert sides[3] is True
+    assert not any(sides[:3] + sides[4:])
+    # the forced one-hot carves out the signal cleanly
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.6
+
+
+def test_forced_categorical_matches_reference(rng, tmp_path):
+    """Cross-check categorical forced split against the reference
+    binary when built: same root decision and close predictions."""
+    ref_bin = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), ".ref_build", "lightgbm")
+    if not os.path.exists(ref_bin):
+        pytest.skip("reference binary not built")
+    n = 2000
+    cat = rng.randint(0, 6, size=n).astype(np.float64)
+    X = np.column_stack([cat, rng.normal(size=n)])
+    y = (cat == 3) * 2.0 + 0.3 * X[:, 1] + 0.05 * rng.normal(size=n)
+    f = _forced_file(tmp_path, {"feature": 0, "threshold": 3})
+    ours = lgb.train({"objective": "regression", "num_leaves": 7,
+                      "verbosity": -1, "min_data_in_leaf": 5,
+                      "forcedsplits_filename": f,
+                      "categorical_feature": [0]},
+                     lgb.Dataset(X, label=y, free_raw_data=False,
+                                 categorical_feature=[0]), 3)
+    import subprocess
+    data = str(tmp_path / "fcat.train")
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.9g")
+    model = str(tmp_path / "fcat_ref.txt")
+    subprocess.run(
+        [ref_bin, "task=train", f"data={data}", "objective=regression",
+         "num_leaves=7", "num_iterations=3", "min_data_in_leaf=5",
+         "categorical_feature=0",
+         f"forcedsplits_filename={f}", f"output_model={model}",
+         "verbosity=-1"], check=True, capture_output=True, timeout=120)
+    ref = lgb.Booster(model_file=model)
+    rt = ref._all_trees()[0]
+    assert rt.split_feature[0] == 0 and bool(rt.decision_type[0] & 1)
+    np.testing.assert_allclose(ours.predict(X), ref.predict(X),
+                               atol=0.25)
+
+
+def test_forced_categorical_unseen_category_dropped(rng, tmp_path):
+    """An unseen (or negative) forced category must be skipped with a
+    warning, not silently remapped to the most frequent category
+    ('Invalid categorical threshold split', feature_histogram.hpp:613)."""
+    n = 1200
+    cat = rng.randint(0, 5, size=n).astype(np.float64)
+    X = np.column_stack([cat, rng.normal(size=n)])
+    y = 0.8 * X[:, 1] + (cat == 2) * 1.0 + 0.05 * rng.normal(size=n)
+    f = _forced_file(tmp_path, {"feature": 0, "threshold": 97})
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "forcedsplits_filename": f,
+                     "categorical_feature": [0]},
+                    lgb.Dataset(X, label=y, free_raw_data=False,
+                                categorical_feature=[0]), 2)
+    t = bst._all_trees()[0]
+    # the dropped forced root falls back to a NORMAL best split: either
+    # a real categorical subset (not the bogus one-hot on the most
+    # frequent category alone) or a numerical split on feature 1
+    if t.split_feature[0] == 0 and bool(t.decision_type[0] & 1):
+        m = bst._gbdt.train_set.bin_mappers[0]
+        most_freq = float(m.categories[0])
+        # not the silent one-hot-on-most-frequent failure mode
+        assert not (len(t.cat_threshold) == 1
+                    and t.cat_threshold[0] == (1 << int(most_freq))
+                    and abs(y[cat == most_freq].mean()
+                            - y[cat != most_freq].mean()) < 0.1)
+    # training still works
+    r2 = 1 - np.mean((bst.predict(X) - y) ** 2) / np.var(y)
+    assert r2 > 0.05
