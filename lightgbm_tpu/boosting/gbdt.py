@@ -57,7 +57,8 @@ class _DeviceData:
     (the per-machine row partition of data_parallel_tree_learner.cpp, done
     by jax.sharding instead of pre_partition'd files)."""
 
-    def __init__(self, ds: Dataset, block: int, plan=None):
+    def __init__(self, ds: Dataset, block: int, plan=None,
+                 unbundle: bool = False):
         # num_data is PER-PROCESS under pre-partitioned multi-host
         # loading (each host's Dataset holds its own row shard); r_pad is
         # the GLOBAL padded row count, r_local this process's slice of it
@@ -68,7 +69,8 @@ class _DeviceData:
         else:
             self.r_pad = ((ds.num_data + block - 1) // block) * block
             self.r_local = self.r_pad
-        bins = _pad_rows(ds.bins, self.r_local)
+        src = ds.unbundled_bins() if unbundle else ds.bins
+        bins = _pad_rows(src, self.r_local)
         row_leaf0 = np.where(np.arange(self.r_local) < ds.num_data, 0, -1) \
             .astype(np.int32)
         if plan is not None:
@@ -118,6 +120,7 @@ class GBDT:
         bp = self.train_set.bundle_plan
         self._bundle_meta = None
         self._bundle_bins = 0
+        self._unbundle_feature = False   # tree_learner=feature w/ EFB
         if bp is not None:
             self._bundle_meta = (jnp.asarray(bp.feat_bundle),
                                  jnp.asarray(bp.feat_offset),
@@ -130,19 +133,24 @@ class GBDT:
             self.block = block_rows_for(self.train_set.num_data, F, self.B)
         # histogram-subtraction gate: the per-leaf raw cache (the
         # HistogramPool analog) must fit the pool budget
-        lattice = (bp.num_bundles * bp.max_bundle_bins if bp is not None
-                   else F * self.B)
-        cache_mb = (config.num_leaves + 1) * lattice * 3 * 4 / 2 ** 20
         pool_budget = (config.histogram_pool_size
                        if config.histogram_pool_size > 0 else 512.0)
-        self._hist_sub = bool(config.hist_subtraction) \
-            and cache_mb <= pool_budget
-        if bool(config.hist_subtraction) and not self._hist_sub:
-            from .. import log as _log
-            _log.warning(
-                f"per-leaf histogram cache would need {cache_mb:.0f} MB "
-                f"(> histogram_pool_size budget {pool_budget:.0f} MB); "
-                "disabling histogram subtraction")
+
+        def _hist_sub_gate(lattice: int) -> bool:
+            cache_mb = ((config.num_leaves + 1) * lattice * 3 * 4
+                        / 2 ** 20)
+            ok = bool(config.hist_subtraction) and cache_mb <= pool_budget
+            if bool(config.hist_subtraction) and not ok:
+                from .. import log as _log
+                _log.warning(
+                    f"per-leaf histogram cache would need {cache_mb:.0f}"
+                    f" MB (> histogram_pool_size budget "
+                    f"{pool_budget:.0f} MB); disabling histogram "
+                    "subtraction")
+            return ok
+        # gate evaluated ONCE, below, after the tree_learner plan is
+        # known (tree_learner=feature may unbundle and change the
+        # lattice; gating here first would warn for the wrong one)
         # data-parallel over every local device (tree_learner param,
         # tree_learner.cpp:15 factory analog; "serial" pins one device)
         if bool(config.linear_tree):
@@ -182,13 +190,19 @@ class GBDT:
                             config.tree_learner, DataParallelPlan)
             if self._bundle_meta is not None and \
                     plan_cls is FeatureParallelPlan:
-                # bundles mix features across the shard boundary; data
-                # and voting unbundle locally instead (tree_builder.py)
-                from .. import log as _log
-                _log.warning(
-                    "EFB-bundled datasets do not support "
-                    "tree_learner=feature; using data-parallel")
-                plan_cls = DataParallelPlan
+                # feature mode shards FEATURES, so the bundled storage
+                # is decoded back to per-feature columns (bundle
+                # histograms unbundled == per-feature histograms, so
+                # training is identical). Rows are replicated on every
+                # chip in this mode anyway — the reference's model
+                # (feature_parallel_tree_learner.cpp:38: each worker
+                # holds the full dataset) — so the width saving EFB
+                # gave up is the mode's own storage model.
+                self._bundle_meta = None
+                self._bundle_bins = 0
+                self._unbundle_feature = True
+                self.block = block_rows_for(
+                    self.train_set.num_data, F, self.B)
             self.plan = plan_cls(top_k=int(config.top_k))
             if self.plan.rows_sharded:
                 # keep the scan block well under the per-shard row count
@@ -197,6 +211,11 @@ class GBDT:
                 cap = max(256, 1 << int(np.floor(np.log2(
                     max(1, per_shard // 4)))))
                 self.block = min(self.block, cap)
+        # single hist-sub gate on the FINAL device lattice (bundle
+        # lattice, or F*B after the feature-mode unbundle above)
+        self._hist_sub = _hist_sub_gate(
+            self._bundle_bins * bp.num_bundles
+            if self._bundle_meta is not None else F * self.B)
         # capacity gate BEFORE the device transfer (VERDICT r4 #5):
         # fail with sized guidance, not a mid-training device OOM
         from ..dataset import check_device_capacity
@@ -208,15 +227,24 @@ class GBDT:
                                // getattr(self.plan, "num_processes", 1))
         else:
             n_row_shards = 1
+        if self._unbundle_feature:
+            # the device holds the UNBUNDLED matrix: per-feature width
+            # and the (possibly narrower) per-feature dtype
+            cap_width = F
+            cap_itemsize = 1 if self.B <= 256 else 2
+        else:
+            cap_width = self.train_set.bins.shape[1]
+            cap_itemsize = self.train_set.bins.dtype.itemsize
         check_device_capacity(
-            self.train_set.num_data, self.train_set.bins.shape[1],
-            self.train_set.bins.dtype.itemsize, config.num_leaves,
-            self._bundle_bins or self.B, self._hist_sub,
-            n_row_shards=n_row_shards)
-        self.train_dd = _DeviceData(self.train_set, self.block, self.plan)
+            self.train_set.num_data, cap_width, cap_itemsize,
+            config.num_leaves, self._bundle_bins or self.B,
+            self._hist_sub, n_row_shards=n_row_shards)
+        self.train_dd = _DeviceData(self.train_set, self.block, self.plan,
+                                    unbundle=self._unbundle_feature)
         self._bins_cm = None            # lazy column-major copy (native)
         self.valid_dd = [
-            _DeviceData(v.construct(), self.block, self.plan)
+            _DeviceData(v.construct(), self.block, self.plan,
+                        unbundle=self._unbundle_feature)
             for v in valid_sets]
         self.valid_sets = list(valid_sets)
 
@@ -371,16 +399,7 @@ class GBDT:
                 & (np.asarray(self.train_set.per_feature_num_bins())
                    > int(config.max_cat_to_onehot)))
         if _csm.any():
-            if self.plan is not None \
-                    and self.plan.parallel_mode == "voting":
-                from .. import log as _log
-                _log.warning(
-                    "tree_learner=voting does not support sorted-subset "
-                    "categorical splits; all categorical features use "
-                    "the one-hot path (raise max_cat_to_onehot to "
-                    "silence)")
-            else:
-                self._cat_sorted_mask = _meta_put(_csm)
+            self._cat_sorted_mask = _meta_put(_csm)
         self.split_params = SplitParams(
             lambda_l1=float(config.lambda_l1),
             lambda_l2=float(config.lambda_l2),
@@ -1168,9 +1187,12 @@ class GBDT:
     # ------------------------------------------------------------------
     def _host_feature_bins(self, bins_h: np.ndarray) -> np.ndarray:
         """Decode an EFB-bundled host bins matrix back to per-feature
-        bins (identity when unbundled) — for host-side binned replay."""
+        bins (identity when unbundled) — for host-side binned replay.
+        Gated on the DEVICE layout (_bundle_meta), not the dataset's
+        bundle_plan: tree_learner=feature stores the device matrix
+        already unbundled and must not decode twice."""
         bp = self.train_set.bundle_plan
-        if bp is None:
+        if bp is None or self._bundle_meta is None:
             return bins_h
         from ..efb import decode_feature_bins
         nb = np.asarray(self.num_bins_pf)

@@ -343,27 +343,19 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 "the serial tree learner too)")
 
     mode = parallel_mode if axis_name is not None else "data"
-    if use_mono_adv and axis_name is not None and mode in ("feature",
-                                                           "voting"):
-        raise NotImplementedError(
-            "monotone_constraints_method=advanced supports the "
-            "serial/data tree learners only")
     if use_bundle and mode == "feature":
-        raise NotImplementedError(
-            "EFB-bundled datasets do not compose with tree_learner="
-            "feature (bundles mix features across the shard boundary); "
-            "use serial/data/voting")
+        # internal invariant, not a user-facing limit: GBDT decodes the
+        # bundled matrix to feature space before entering this mode
+        # (Dataset.unbundled_bins), so bundle_meta never reaches here
+        raise ValueError(
+            "feature-parallel requires an unbundled bin matrix "
+            "(caller must decode EFB storage first)")
     if mode == "feature":
         if local_bins is None or local_meta is None or feat_offset is None:
             raise ValueError(
                 "feature-parallel needs local_bins/local_meta/feat_offset")
         (loc_nbpf, loc_nanpf, loc_catpf, loc_fmask, loc_mono) = local_meta
         F_loc = loc_nbpf.shape[0]
-    if mode == "voting" and cat_sorted_mask is not None:
-        raise NotImplementedError(
-            "tree_learner=voting with sorted-subset categoricals is not "
-            "supported (the elected-subset split search needs per-slot "
-            "feature metadata); set max_cat_to_onehot high enough")
 
     # quantized training: histograms come back int32 (exact); descale to
     # (sum_g, sum_h, count) f32 once per build — the single-pass analog of
@@ -647,12 +639,18 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             cs_loc = (jax.lax.dynamic_slice(
                 cat_sorted_mask, (feat_offset,), (F_loc,))
                 if cat_sorted_mask is not None else None)
+            # advanced monotone composes the same replicated way: the
+            # bounds lattice is computed over global F (box state and
+            # tree are replicated) and sliced at this chip's window
+            adv_loc = (tuple(jax.lax.dynamic_slice(
+                a, (0, feat_offset, 0), (S, F_loc, a.shape[2]))
+                for a in adv) if adv is not None else None)
             bs = find_best_splits(
                 hist2w, loc_nbpf, loc_nanpf, loc_catpf, sp,
                 feature_mask=fmask_loc, mono_type=loc_mono,
                 leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
                 slot_depth=slot_depth, rand_bin=rand_loc,
-                cat_sorted_mask=cs_loc)
+                cat_sorted_mask=cs_loc, adv_bounds=adv_loc)
             bs["feature"] = bs["feature"] + feat_offset
         elif mode == "voting":
             S = slots_c.shape[0]
@@ -662,6 +660,7 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 feature_mask=fmask_s, mono_type=mono_type_pf,
                 leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
                 slot_depth=slot_depth, rand_bin=rand_bin,
+                cat_sorted_mask=cat_sorted_mask, adv_bounds=adv,
                 return_feature_gain=True)
             fg = bs_loc["feature_gain"]                       # [S, F]
             k = min(top_k, F)
@@ -692,7 +691,18 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
                 slot_depth=slot_depth,
                 rand_bin=(jnp.take_along_axis(rand_bin, elected, axis=1)
-                          if rand_bin is not None else None))
+                          if rand_bin is not None else None),
+                # sorted-subset categoricals compose: the elected-column
+                # metadata is per-slot [S, k2] and both finders
+                # broadcast 2-D metadata
+                cat_sorted_mask=(jnp.take(cat_sorted_mask, elected)
+                                 if cat_sorted_mask is not None
+                                 else None),
+                # advanced monotone: gather the bounds lattice at the
+                # elected columns ([S, F, B] -> [S, k2, B])
+                adv_bounds=(tuple(jnp.take_along_axis(
+                    a, elected[:, :, None], axis=1) for a in adv)
+                    if adv is not None else None))
             bs["feature"] = jnp.take_along_axis(
                 elected, bs["feature"][:, None], axis=1)[:, 0] \
                 .astype(jnp.int32)

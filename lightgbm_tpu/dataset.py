@@ -676,6 +676,32 @@ class Dataset:
         return np.asarray([self.bin_mappers[f].num_bin
                            for f in self.used_features], dtype=np.int32)
 
+    def unbundled_bins(self) -> np.ndarray:
+        """Per-feature [R, F] bin matrix decoded from EFB bundle storage
+        (decode_feature_bins applied column-wise); ``self.bins`` itself
+        when no bundling. tree_learner=feature uses this: it shards
+        FEATURES and replicates rows, so it needs per-feature columns
+        and gives up nothing (each worker holds the full dataset in the
+        reference too, feature_parallel_tree_learner.cpp:38)."""
+        bp = self.bundle_plan
+        if bp is None:
+            return self.bins
+        from .efb import decode_feature_bins
+        nb = self.per_feature_num_bins()
+        dt = np.uint8 if int(nb.max()) <= 256 else np.uint16
+        R, F = self.bins.shape[0], len(nb)
+        out = np.empty((R, F), dt)
+        # decode in row blocks: the int32 gather/compare intermediates
+        # are ~8 bytes/cell, so a whole-matrix pass would spike host
+        # memory ~10x over the final matrix at EFB-wide shapes
+        blk = max(1, (64 << 20) // max(1, 8 * F))
+        for r0 in range(0, R, blk):
+            raw = self.bins[r0:r0 + blk, bp.feat_bundle].astype(np.int32)
+            out[r0:r0 + blk] = decode_feature_bins(
+                raw, bp.feat_offset[None, :], nb[None, :],
+                bp.feat_mfb[None, :])
+        return out
+
     def per_feature_nan_bins(self) -> np.ndarray:
         """nan bin index per used feature; -1 when the feature has none."""
         return np.asarray([self.bin_mappers[f].nan_bin

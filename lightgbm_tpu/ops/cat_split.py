@@ -49,9 +49,10 @@ def find_best_cat_sorted(hist: jax.Array, num_bins_per_feat: jax.Array,
 
     Args:
       hist: [L, F, B, 3] histograms.
-      num_bins_per_feat: [F] int32.
-      cat_sorted_mask: [F] bool — categorical features on the sorted path
-        (num_bin > max_cat_to_onehot).
+      num_bins_per_feat: [F] or per-slot [L, F] int32 (voting-parallel
+        passes per-slot elected-column metadata).
+      cat_sorted_mask: [F] or [L, F] bool — categorical features on the
+        sorted path (num_bin > max_cat_to_onehot).
       params: SplitParams (cat_l2/cat_smooth/max_cat_threshold/
         min_data_per_group are read here).
       pg: [L, F] parent gain (gain_shift), shared with the main finder.
@@ -83,9 +84,13 @@ def find_best_cat_sorted(hist: jax.Array, num_bins_per_feat: jax.Array,
 
     # candidate bins: enough data (feature_histogram.cpp:240-245 uses the
     # hessian-estimated count >= cat_smooth) and within the feature's range
+    nb2 = (num_bins_per_feat if num_bins_per_feat.ndim == 2
+           else num_bins_per_feat[None, :])                      # [M, F]
+    cs2 = (cat_sorted_mask if cat_sorted_mask.ndim == 2
+           else cat_sorted_mask[None, :])
     cand = ((n >= params.cat_smooth)
-            & (iota[None, None, :] < num_bins_per_feat[None, :, None])
-            & cat_sorted_mask[None, :, None])                    # [L, F, B]
+            & (iota[None, None, :] < nb2[:, :, None])
+            & cs2[:, :, None])                                   # [L, F, B]
     used_bin = cand.sum(axis=2).astype(jnp.int32)                # [L, F]
 
     # CTR sort ascending; non-candidates sink to the end
@@ -166,9 +171,12 @@ def find_best_cat_sorted(hist: jax.Array, num_bins_per_feat: jax.Array,
         cnt_cur = jnp.where(elig, 0.0, cnt_cur)
         return (cnt_cur, broken), elig
 
-    zeros2 = jnp.zeros((2, L, F))
+    # carry derived FROM the data (not fresh zeros) so its varying
+    # manual axes match the xs under shard_map (voting's psum'd
+    # elected histograms are device-varying)
+    zeros2 = lc2[:, :, :, 0] * 0.0
     (_, _), elig_t = jax.lax.scan(
-        scan_body, (zeros2, jnp.zeros((2, L, F), bool)),
+        scan_body, (zeros2, zeros2.astype(bool)),
         (cnt_steps, left_ok_t, rfail_t, inr_t))
     elig = jnp.transpose(elig_t, (2, 3, 0, 1))                   # [L,F,B,2]
 
@@ -237,4 +245,7 @@ def find_best_cat_sorted(hist: jax.Array, num_bins_per_feat: jax.Array,
         "left_out": take(out_l),
         "right_out": take(out_r),
         "member": member,
+        # per-feature best sorted gain — merged into the main finder's
+        # feature_gain so voting ballots see sorted-subset candidates
+        "feature_gain": net.max(axis=(2, 3)),
     }

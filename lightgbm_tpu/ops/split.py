@@ -156,9 +156,10 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
       slot_depth: optional [L] int32 — leaf depth, for monotone_penalty.
       rand_bin: optional [L, F] int32 — extra-trees random threshold;
         only this bin is evaluated per (leaf, feature).
-      cat_sorted_mask: optional [F] bool — categorical features with more
-        than max_cat_to_onehot bins; they take the sorted-subset path
-        (ops/cat_split.py) instead of one-hot. Requires 1-D metadata.
+      cat_sorted_mask: optional [F] or per-slot [L, F] bool —
+        categorical features with more than max_cat_to_onehot bins;
+        they take the sorted-subset path (ops/cat_split.py) instead of
+        one-hot (voting-parallel passes the per-slot elected form).
       return_feature_gain: also return "feature_gain" [L, F] — the best
         net gain per (leaf, feature) — for voting-parallel vote rounds.
       gain_scale: optional [F] or [L, F] f32 — multiplies each feature's
@@ -190,12 +191,6 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     use_mono = mono_type is not None
     use_smooth = params.path_smooth > 0.0
     bins_iota = jnp.arange(B, dtype=jnp.int32)
-
-    per_slot_meta = num_bins_per_feat.ndim == 2
-    if per_slot_meta and cat_sorted_mask is not None:
-        raise NotImplementedError(
-            "sorted-subset categorical splits need 1-D feature metadata "
-            "(not supported under voting-parallel subsets)")
 
     def _2d(a):
         return a if a is None or a.ndim == 2 else a[None, :]
@@ -233,7 +228,7 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
 
     # ---- categorical one-hot: left = {bin == t}; sorted-path features are
     # excluded here (reference picks ONE path by bin count, not best-of-both)
-    onehot_f = (cat2 & ~cat_sorted_mask[None, :]) \
+    onehot_f = (cat2 & ~_2d(cat_sorted_mask)) \
         if cat_sorted_mask is not None else cat2
     cat_left = hist[:, :, :, None, :]                           # reuse lattice
     cat_right = tot[:, :, :, None, :] - cat_left
@@ -390,6 +385,9 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
                 sg = jnp.where(jnp.isfinite(sg), sg - jnp.take_along_axis(
                     gain_penalty, sf, axis=1)[:, 0], sg)
             srt["gain"] = jnp.where(sg > 1e-10, sg, NEG_INF)
+        if return_feature_gain:
+            out["feature_gain"] = jnp.maximum(out["feature_gain"],
+                                              srt["feature_gain"])
         pick = srt["gain"] > out["gain"]
         out["gain"] = jnp.where(pick, srt["gain"], out["gain"])
         out["feature"] = jnp.where(pick, srt["feature"], out["feature"])

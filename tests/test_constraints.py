@@ -272,3 +272,29 @@ def test_advanced_mode_scales_to_255_leaves_128_features(rng):
     base[:, 0] = np.linspace(-3, 3, 64)
     p = bst.predict(base)
     assert (np.diff(p) >= -1e-6).all()
+
+
+def test_monotone_advanced_composes_with_voting_and_feature(rng):
+    """monotone_constraints_method=advanced under the parallel
+    learners: the bounds lattice is computed from REPLICATED tree/box
+    state, sliced per chip (feature) or gathered at the elected
+    columns (voting) — so with full top_k every learner must emit the
+    identical model, and all must stay monotone."""
+    X, y = _mono_data(rng)
+    params = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1, "monotone_constraints": [1, -1, 0],
+              "min_data_in_leaf": 5,
+              "monotone_constraints_method": "advanced"}
+    preds = {}
+    for tl in ("serial", "data", "voting", "feature"):
+        p = dict(params, tree_learner=tl)
+        if tl == "voting":
+            p["top_k"] = X.shape[1]   # full top-k == data-parallel
+        bst = lgb.train(p, lgb.Dataset(X, label=y,
+                                       free_raw_data=False), 10)
+        assert _is_monotone(bst, X, 0, increasing=True), tl
+        assert _is_monotone(bst, X, 1, increasing=False), tl
+        preds[tl] = bst.predict(X)
+    for tl in ("data", "voting", "feature"):
+        np.testing.assert_allclose(preds["serial"], preds[tl],
+                                   rtol=1e-5, atol=1e-6, err_msg=tl)
