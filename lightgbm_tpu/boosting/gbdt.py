@@ -231,7 +231,7 @@ class GBDT:
             # the device holds the UNBUNDLED matrix: per-feature width
             # and the (possibly narrower) per-feature dtype
             cap_width = F
-            cap_itemsize = 1 if self.B <= 256 else 2
+            cap_itemsize = 1 if self.B <= 256 else 4  # unbundled_bins dtype
         else:
             cap_width = self.train_set.bins.shape[1]
             cap_itemsize = self.train_set.bins.dtype.itemsize

@@ -688,7 +688,11 @@ class Dataset:
             return self.bins
         from .efb import decode_feature_bins
         nb = self.per_feature_num_bins()
-        dt = np.uint8 if int(nb.max()) <= 256 else np.uint16
+        # int32 (not uint16) above 256 bins: every downstream bins
+        # consumer — including the native FFI dispatch, which reads
+        # "uint8 else int32" (native/hist_ffi.cc) — handles exactly
+        # those two dtypes
+        dt = np.uint8 if int(nb.max()) <= 256 else np.int32
         R, F = self.bins.shape[0], len(nb)
         out = np.empty((R, F), dt)
         # decode in row blocks: the int32 gather/compare intermediates

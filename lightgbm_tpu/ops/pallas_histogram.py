@@ -79,7 +79,13 @@ def _kernel(bins_ref, gh_ref, leaf_ref, lids_ref, out_ref, *,
         # leaf mask: [blk, L_pad]; pad slots are -2 and never match
         mask = (leaf_ref[:, 0:1] == lids_ref[0:1, :]).astype(cdt)
         ghb = gh_ref[:].astype(cdt)                       # [blk, 8]
-        ghl = (mask[:, :, None] * ghb[:, None, :HIST_CH]).reshape(
+        # NOTE: ghb[:, None, :HIST_CH] (newaxis + partial slice in one
+        # index) lowers via lax.gather, which Mosaic rejects at this
+        # shape ("Shape mismatch in input, indices and output" — first
+        # real-hardware finding, r5). A static slice + expand_dims is
+        # the same math with no gather.
+        gh3 = jnp.expand_dims(ghb[:, :HIST_CH], 1)        # [blk, 1, 3]
+        ghl = (jnp.expand_dims(mask, 2) * gh3).reshape(
             blk, l_pad * HIST_CH)
         if lb3_pad != l_pad * HIST_CH:
             ghl = jnp.pad(ghl,

@@ -123,6 +123,62 @@ def test_pallas_interpret_matches_oracle(rng):
     np.testing.assert_allclose(pls, xla, rtol=1e-5, atol=1e-5)
 
 
+def test_pallas_kernel_body_is_gather_free():
+    """First real-Mosaic contact (round 5) rejected the kernel: a mixed
+    newaxis + partial-slice index (``ghb[:, None, :HIST_CH]``) lowered
+    via lax.gather, and Mosaic's gather rule only accepts a narrow shape
+    class ("Shape mismatch in input, indices and output"). The kernel
+    body must stay free of gather so it keeps compiling on hardware the
+    interpreter cannot stand in for. Traced here with production-shaped
+    block operands (the aligned 64-bin plan)."""
+    import functools
+    import unittest.mock as mock
+
+    from jax.experimental import pallas as pl
+
+    from lightgbm_tpu.ops import pallas_histogram as PH
+    from lightgbm_tpu.ops.histogram import HIST_CH
+
+    F, B, L = 16, 64, 8
+    blk, fc, Bp, l_pad = PH._plan_chunks(F, B, L)
+    fb_pad = -(-(fc * Bp) // 128) * 128
+    lb3_pad = -(-(l_pad * HIST_CH) // 128) * 128
+    kern = functools.partial(PH._kernel, num_bins=Bp, cdt=jnp.bfloat16,
+                             fb_pad=fb_pad, lb3_pad=lb3_pad,
+                             acc_dt=jnp.float32)
+
+    class _Ref:
+        def __init__(self, a):
+            self.a = a
+
+        def __getitem__(self, idx):
+            return self.a[idx]
+
+        def __setitem__(self, idx, val):
+            pass
+
+        @property
+        def shape(self):
+            return self.a.shape
+
+    def body(bins, gh, leaf, lids):
+        out = _Ref(jnp.zeros((fb_pad, lb3_pad), jnp.float32))
+        with mock.patch.object(pl, "program_id",
+                               lambda i: jnp.int32(1)), \
+             mock.patch.object(pl, "when",
+                               lambda c: (lambda f: f())):
+            kern(_Ref(bins), _Ref(gh), _Ref(leaf), _Ref(lids), out)
+        return jnp.zeros(())
+
+    jaxpr = jax.make_jaxpr(body)(
+        jnp.zeros((blk, fc), jnp.int32), jnp.zeros((blk, 8), jnp.float32),
+        jnp.zeros((blk, 8), jnp.int32), jnp.zeros((8, l_pad), jnp.int32))
+    prims = {e.primitive.name for e in jaxpr.jaxpr.eqns}
+    assert "gather" not in prims, (
+        "pallas kernel body reintroduced a lax.gather — Mosaic rejects "
+        f"it on real TPUs (primitives: {sorted(prims)})")
+
+
 def test_pallas_dynamic_row_bound_skips_blocks(rng):
     """VERDICT r4 #3: with ``num_rows`` the kernel must never touch row
     blocks past ``ceil(num_rows / blk)``. Rows past the bound are

@@ -365,7 +365,6 @@ def test_efb_feature_parallel_rollback_replays_correctly(rng):
     bst = lgb.train(params, lgb.Dataset(X, label=y,
                                         free_raw_data=False), 3)
     assert bst._gbdt._unbundle_feature
-    scores_after_2 = None
     # train 2 then snapshot, train a 3rd, roll it back: scores must
     # return exactly to the 2-tree state
     b2 = lgb.train(params, lgb.Dataset(X, label=y,
