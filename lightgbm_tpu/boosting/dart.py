@@ -36,6 +36,17 @@ class DART(GBDT):
                  objective: Optional[Objective],
                  valid_sets: Sequence[Dataset] = (), **kwargs):
         super().__init__(config, train_set, objective, valid_sets, **kwargs)
+        if self.plan is not None and getattr(self.plan, "shard_storage",
+                                             False):
+            # drop/restore replays stored trees with predict_bins_value
+            # over the device matrix; on a column-sharded matrix that
+            # per-row gather would all-gather the full [R, F] onto every
+            # chip — the exact OOM the sharded mode exists to avoid
+            raise NotImplementedError(
+                "boosting=dart is incompatible with feature_shard_storage"
+                " (tree replay needs whole-matrix row gathers); use "
+                "tree_learner=data for DART, or drop "
+                "feature_shard_storage")
         self._rng_drop = np.random.RandomState(config.drop_seed)
         self._tree_weight: List[float] = []  # per-iteration weights
         self._sum_weight = 0.0

@@ -74,7 +74,7 @@ class _DeviceData:
         row_leaf0 = np.where(np.arange(self.r_local) < ds.num_data, 0, -1) \
             .astype(np.int32)
         if plan is not None:
-            self.bins = plan.shard_rows(bins)
+            self.bins = plan.shard_bins(bins)
             self.row_leaf0 = plan.shard_rows(row_leaf0)
         else:
             self.bins = jnp.asarray(bins)
@@ -203,7 +203,15 @@ class GBDT:
                 self._unbundle_feature = True
                 self.block = block_rows_for(
                     self.train_set.num_data, F, self.B)
-            self.plan = plan_cls(top_k=int(config.top_k))
+            plan_kw = {}
+            if plan_cls is FeatureParallelPlan:
+                plan_kw["shard_storage"] = bool(
+                    config.feature_shard_storage)
+            elif config.feature_shard_storage:
+                from .. import log as _log
+                _log.warning("feature_shard_storage only applies with "
+                             "tree_learner=feature; ignoring")
+            self.plan = plan_cls(top_k=int(config.top_k), **plan_kw)
             if self.plan.rows_sharded:
                 # keep the scan block well under the per-shard row count
                 # so shard-granular padding stays a small fraction
@@ -211,11 +219,17 @@ class GBDT:
                 cap = max(256, 1 << int(np.floor(np.log2(
                     max(1, per_shard // 4)))))
                 self.block = min(self.block, cap)
+        # column-sharded storage keeps only the local feature slice of
+        # the matrix AND the hist cache per device: one divisor feeds
+        # both the hist-sub gate and the capacity gate below
+        n_fs = (self.plan.num_shards
+                if self.plan is not None
+                and getattr(self.plan, "shard_storage", False) else 1)
         # single hist-sub gate on the FINAL device lattice (bundle
         # lattice, or F*B after the feature-mode unbundle above)
-        self._hist_sub = _hist_sub_gate(
-            self._bundle_bins * bp.num_bundles
-            if self._bundle_meta is not None else F * self.B)
+        _lattice = (self._bundle_bins * bp.num_bundles
+                    if self._bundle_meta is not None else F * self.B)
+        self._hist_sub = _hist_sub_gate(-(-_lattice // n_fs))
         # capacity gate BEFORE the device transfer (VERDICT r4 #5):
         # fail with sized guidance, not a mid-training device OOM
         from ..dataset import check_device_capacity
@@ -235,6 +249,9 @@ class GBDT:
         else:
             cap_width = self.train_set.bins.shape[1]
             cap_itemsize = self.train_set.bins.dtype.itemsize
+        # feature_shard_storage: each device stores only its own column
+        # slice of the (padded) matrix
+        cap_width = -(-cap_width // n_fs)
         check_device_capacity(
             self.train_set.num_data, cap_width, cap_itemsize,
             config.num_leaves, self._bundle_bins or self.B,

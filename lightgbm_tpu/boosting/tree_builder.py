@@ -131,7 +131,7 @@ def build_tree(*args, hist_impl: str = "auto", **kwargs):
                      "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins", "mono_method",
-                     "forced", "hist_sub"))
+                     "forced", "hist_sub", "feature_sharded"))
 def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
@@ -159,7 +159,8 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                mono_method: str = "basic",
                forced: Optional[Tuple] = None,
                hist_sub: bool = True,
-               bins_cm: Optional[jax.Array] = None):
+               bins_cm: Optional[jax.Array] = None,
+               feature_sharded: bool = False):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
@@ -212,7 +213,10 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         if _native.hist_lib() is None:
             hist_impl = "scatter"
             hist_compact = hist_sub
-    use_native_part = hist_impl == "native" and bundle_meta is None
+    # sharded feature storage: no device holds the full matrix, so the
+    # native CPU partition/relabel (which walk every column) cannot run
+    use_native_part = (hist_impl == "native" and bundle_meta is None
+                       and not feature_sharded)
     R = bins.shape[0]
     F = num_bins_pf.shape[0]   # per-FEATURE count (bins may be bundled)
     L = num_leaves
@@ -356,6 +360,8 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 "feature-parallel needs local_bins/local_meta/feat_offset")
         (loc_nbpf, loc_nanpf, loc_catpf, loc_fmask, loc_mono) = local_meta
         F_loc = loc_nbpf.shape[0]
+    if feature_sharded and mode != "feature":
+        raise ValueError("feature_sharded requires parallel_mode='feature'")
 
     # quantized training: histograms come back int32 (exact); descale to
     # (sum_g, sum_h, count) f32 once per build — the single-pass analog of
@@ -1198,7 +1204,8 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         # 4-byte read instead of streaming the full gather/select chain
         # (bundled matrices decode bins in feature space, so they keep
         # the XLA formulation)
-        use_native_relabel = hist_impl == "native" and not use_bundle
+        use_native_relabel = (hist_impl == "native" and not use_bundle
+                              and not feature_sharded)
 
         def relabel(bmat, rl):
             # only VALID matrices reach the native relabel: the train
@@ -1223,7 +1230,22 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             rlc = jnp.where(rl < 0, DUMMY_LEAF, rl)
             active = jnp.take(pend_active, rlc)
             feat = jnp.take(pend_feat, rlc)
-            binv = feature_bin_of(bmat, feat)
+            if feature_sharded:
+                # each device holds only its [R, F_loc] column shard;
+                # the split feature of a row's leaf is owned by exactly
+                # ONE shard, so a masked local gather + psum over the
+                # feature axis reconstructs the bin value everywhere
+                # (one [R] int32 all-reduce per relabel — the sharded
+                # analog of the reference's full-copy re-partition,
+                # feature_parallel_tree_learner.cpp:77)
+                F_m = bmat.shape[1]
+                fl = feat - feat_offset
+                owned = active & (fl >= 0) & (fl < F_m)
+                bl = row_feature_gather(
+                    bmat, jnp.clip(fl, 0, F_m - 1)).astype(jnp.int32)
+                binv = jax.lax.psum(jnp.where(owned, bl, 0), axis_name)
+            else:
+                binv = feature_bin_of(bmat, feat)
             thr = jnp.take(pend_thr, rlc)
             nb = jnp.take(nan_bin_pf, feat)
             isnan = (binv == nb) & (nb >= 0)

@@ -137,6 +137,16 @@ PARAMS: Dict[str, ParamSpec] = {
         _p("cat_smooth", 10.0, float),
         _p("max_cat_to_onehot", 4, int),
         _p("top_k", 20, int, aliases=("topk",)),
+        _p("feature_shard_storage", False, bool,
+           doc="with tree_learner=feature: store only each device's "
+               "feature shard of the bin matrix ([R, F/devices] per "
+               "chip instead of a replicated [R, F]) — the TPU-native "
+               "answer to datasets whose dense matrix exceeds one "
+               "chip's HBM (the reference instead has per-feature "
+               "sparse storage, sparse_bin.hpp). Split finding is "
+               "already feature-local; the partition step resolves "
+               "each row's split-feature bin with a one-hot psum over "
+               "the feature axis"),
         _p("monotone_constraints", [], list,
            aliases=("mc", "monotone_constraint", "monotonic_cst")),
         _p("monotone_constraints_method", "basic", str,

@@ -93,9 +93,12 @@ def check_device_capacity(num_rows: int, width: int, itemsize: int,
         "columns are mutually exclusive enough to bundle (EFB). "
         "Options: enable_bundle=true with a larger max_conflict_rate; "
         "max_bin<=255 keeps columns uint8; shard rows over more "
-        "devices/hosts (tree_learner=data); or reduce features "
-        "up-front. The reference's sparse_bin.hpp storage has no dense "
-        "analog here yet (README 'Sparse data').")
+        "devices/hosts (tree_learner=data); shard COLUMNS over devices "
+        "(tree_learner=feature with feature_shard_storage=true — each "
+        "chip then stores only width/devices columns); or reduce "
+        "features up-front. The reference's sparse_bin.hpp per-feature "
+        "sparse storage maps to the column-sharded mode here (README "
+        "'Sparse data').")
 
 
 class Sequence:

@@ -118,6 +118,11 @@ class DataParallelPlan:
         return jax.make_array_from_process_local_data(
             NamedSharding(self.mesh, spec), np.asarray(arr))
 
+    def shard_bins(self, arr):
+        """Place a [rows, features] bin matrix on the mesh. Data/voting
+        plans shard its ROWS like every other per-row array."""
+        return self.shard_rows(arr)
+
     def shard_scores(self, local_kr):
         """[K, local_rows] host block -> [K, r_pad] global, row axis 1."""
         if not self.multi_process:
@@ -196,11 +201,19 @@ class FeatureParallelPlan:
     rows_sharded = False
 
     def __init__(self, devices: Optional[Sequence[jax.Device]] = None,
-                 axis_name: str = AXIS, top_k: int = 20):
+                 axis_name: str = AXIS, top_k: int = 20,
+                 shard_storage: bool = False):
         self.mesh = make_mesh(devices, axis_name)
         self.axis_name = axis_name
         self.num_shards = self.mesh.devices.size
         self.top_k = top_k
+        # feature_shard_storage: each device stores only its own
+        # [R, F/num_shards] feature slice of the bin matrix instead of
+        # a replicated copy — the split work is feature-local either
+        # way; only the partition step needs the one-hot psum (see
+        # build_tree(feature_sharded=True)). This is how a bin matrix
+        # wider than one chip's HBM becomes trainable.
+        self.shard_storage = shard_storage
         self.num_processes = jax.process_count()
         self.multi_process = self.num_processes > 1
         if self.multi_process:
@@ -220,6 +233,20 @@ class FeatureParallelPlan:
     def shard_rows(self, arr):
         # rows live whole on every chip
         return replicate(self.mesh, arr)
+
+    def shard_bins(self, arr):
+        """Bin matrices: replicated normally; column-sharded (feature
+        axis padded host-side to a multiple of the shard count) with
+        ``shard_storage`` so each device holds [R, F_pad/n]."""
+        if not self.shard_storage:
+            return replicate(self.mesh, arr)
+        n = self.num_shards
+        F = arr.shape[1]
+        F_pad = -(-F // n) * n
+        if F_pad != F:
+            arr = np.pad(np.asarray(arr), ((0, 0), (0, F_pad - F)))
+        return jax.device_put(
+            arr, NamedSharding(self.mesh, P(None, self.axis_name)))
 
     def shard_scores(self, local_kr):
         return jnp.asarray(local_kr)
@@ -256,7 +283,7 @@ class FeatureParallelPlan:
             block_rows=block_rows, n_shards=self.num_shards,
             has_mono=has_mono, mono_method=mono_method,
             feature_fraction_bynode=feature_fraction_bynode,
-            hist_sub=hist_sub)
+            hist_sub=hist_sub, sharded=self.shard_storage)
 
 
 @functools.partial(
@@ -264,20 +291,28 @@ class FeatureParallelPlan:
     static_argnames=("mesh", "num_leaves", "leaf_batch", "max_depth",
                      "num_bins", "split_params", "axis_name", "hist_dtype",
                      "hist_impl", "block_rows", "n_shards", "has_mono",
-                     "mono_method", "feature_fraction_bynode", "hist_sub"))
+                     "mono_method", "feature_fraction_bynode", "hist_sub",
+                     "sharded"))
 def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, mono_arr,
                        fp_extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl,
                        block_rows, n_shards, has_mono, mono_method="basic",
-                       feature_fraction_bynode=1.0, hist_sub=True):
-    R, F = bins.shape
+                       feature_fraction_bynode=1.0, hist_sub=True,
+                       sharded=False):
+    R = bins.shape[0]
+    F = num_bins_pf.shape[0]
     # pad the feature axis so it splits evenly; pad features are trivial
     # (1 bin, masked out) and never selected
     F_pad = ((F + n_shards - 1) // n_shards) * n_shards
     pf = F_pad - F
-    bins_p = jnp.pad(bins, ((0, 0), (0, pf)))
+    if sharded:
+        # shard_bins already padded + column-sharded the matrix
+        assert bins.shape[1] == F_pad, (bins.shape, F_pad)
+        bins_p = bins
+    else:
+        bins_p = jnp.pad(bins, ((0, 0), (0, pf)))
     num_bins_p = jnp.pad(num_bins_pf, (0, pf), constant_values=1)
     nan_bin_p = jnp.pad(nan_bin_pf, (0, pf), constant_values=-1)
     is_cat_p = jnp.pad(is_cat_pf, (0, pf))
@@ -312,7 +347,8 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             local_meta=(loc_nbpf, loc_nanpf, loc_catpf, loc_fmask,
                         loc_mono if has_mono else None),
             feat_offset=offset, quant_scales=qs,
-            mono_method=mono_method, hist_sub=hist_sub)
+            mono_method=mono_method, hist_sub=hist_sub,
+            feature_sharded=sharded)
 
     # replicated extras padded to the sharded feature width
     qs, groups, key, csm = fp_extras
@@ -324,12 +360,26 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
-    valid_in_specs = tuple([rep] * (2 * n_valid))
     extras_specs = jax.tree.map(lambda _: rep, fp_extras)
+
+    if sharded:
+        # valid matrices are column-sharded like the train matrix (their
+        # relabel resolves split-feature bins with the same psum); their
+        # feature axes are padded to F_pad here — tiny next to training
+        # data, and pad features are never selected
+        valid_flat = tuple(
+            jnp.pad(v, ((0, 0), (0, F_pad - v.shape[1])))
+            if i < n_valid and v.shape[1] != F_pad else v
+            for i, v in enumerate(valid_flat))
+        valid_in_specs = tuple([fsh2] * n_valid + [rep] * n_valid)
+        mat_spec = fsh2
+    else:
+        valid_in_specs = tuple([rep] * (2 * n_valid))
+        mat_spec = rep
 
     fn = jax.shard_map(
         step, mesh=mesh,
-        in_specs=(rep, fsh2, rep, rep, rep, rep, rep, rep,
+        in_specs=(mat_spec, fsh2, rep, rep, rep, rep, rep, rep,
                   fsh, fsh, fsh, fsh, fsh, rep, valid_in_specs,
                   extras_specs),
         out_specs=(tree_specs, rep, tuple([rep] * n_valid)),

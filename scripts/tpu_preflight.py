@@ -1,0 +1,143 @@
+"""First-hardware-contact drill: validate the Pallas kernel on real Mosaic.
+
+Run with the axon tunnel up (`python scripts/tpu_preflight.py`). Steps:
+1. compile + run the Pallas histogram kernel (f32, num_rows-bounded,
+   int8-quantized) at a production-shaped plan, parity vs the matmul
+   formulation on-device;
+2. time pallas vs matmul at Higgs shape (1M x 28 x 63 bins x 255 leaves);
+3. one real training round end-to-end with hist_impl=auto (which should
+   resolve to pallas after the probe).
+
+Prints PASS/FAIL per step; exits non-zero on any failure so the driver
+can gate the full bench on it.
+"""
+
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    print(f"backend: {backend} devices: {jax.devices()}", flush=True)
+    if backend != "tpu":
+        print("FAIL: not a tpu backend")
+        return 1
+
+    from lightgbm_tpu.ops import pallas_histogram as ph
+    from lightgbm_tpu.ops.histogram import build_histograms
+
+    rng = np.random.default_rng(0)
+    fails = 0
+
+    # -- step 1: compile + parity at a small production-aligned shape
+    R, F, B, L = 8192, 16, 64, 8
+    bins = jnp.asarray(rng.integers(0, B, (R, F)), jnp.uint8)
+    gh = jnp.asarray(
+        np.stack([rng.standard_normal(R), rng.uniform(0.1, 1, R),
+                  np.ones(R)], 1), jnp.float32)
+    leaf = jnp.asarray(rng.integers(0, L, (R,)), jnp.int32)
+    lids = jnp.arange(L, dtype=jnp.int32)
+    ref = jnp.asarray(build_histograms(bins, gh, leaf, lids, num_bins=B,
+                                       impl="matmul"), jnp.float32)
+
+    for name, kw in [
+        ("f32", dict()),
+        ("num_rows", dict(num_rows=jnp.asarray(R, jnp.int32))),
+    ]:
+        try:
+            t0 = time.time()
+            out = ph.build_histograms_pallas(bins, gh, leaf, lids,
+                                             num_bins=B, **kw)
+            jax.block_until_ready(out)
+            err = float(jnp.max(jnp.abs(jnp.asarray(out, jnp.float32)
+                                        - ref)))
+            rel = err / max(1e-9, float(jnp.max(jnp.abs(ref))))
+            ok = rel < 1e-2  # bf16 addends
+            print(f"step1[{name}]: {'PASS' if ok else 'FAIL'} "
+                  f"compile+run {time.time()-t0:.1f}s rel_err {rel:.2e}",
+                  flush=True)
+            fails += 0 if ok else 1
+        except Exception as e:
+            print(f"step1[{name}]: FAIL {type(e).__name__}: {e}",
+                  flush=True)
+            fails += 1
+
+    try:
+        ghq = jnp.asarray(rng.integers(-127, 128, (R, 3)), jnp.int8)
+        outq = ph.build_histograms_pallas(bins, ghq, leaf, lids,
+                                          num_bins=B)
+        refq = build_histograms(bins, ghq, leaf, lids, num_bins=B,
+                                impl="matmul")
+        errq = int(jnp.max(jnp.abs(jnp.asarray(outq, jnp.int32)
+                                   - jnp.asarray(refq, jnp.int32))))
+        ok = errq == 0
+        print(f"step1[quant]: {'PASS' if ok else 'FAIL'} "
+              f"int32 err {errq}", flush=True)
+        fails += 0 if ok else 1
+    except Exception as e:
+        print(f"step1[quant]: FAIL {type(e).__name__}: {e}", flush=True)
+        fails += 1
+
+    # -- step 2: pallas vs matmul at Higgs shape
+    try:
+        R2, F2, B2, L2 = 1 << 20, 28, 63, 255
+        bins2 = jnp.asarray(rng.integers(0, B2, (R2, F2)), jnp.uint8)
+        gh2 = jnp.asarray(
+            np.stack([rng.standard_normal(R2), rng.uniform(0.1, 1, R2),
+                      np.ones(R2)], 1), jnp.float32)
+        leaf2 = jnp.asarray(rng.integers(0, L2, (R2,)), jnp.int32)
+        lids2 = jnp.arange(L2, dtype=jnp.int32)
+        for impl, fn in [
+            ("pallas", lambda: ph.build_histograms_pallas(
+                bins2, gh2, leaf2, lids2, num_bins=B2)),
+            ("matmul", lambda: build_histograms(
+                bins2, gh2, leaf2, lids2, num_bins=B2, impl="matmul")),
+        ]:
+            jax.block_until_ready(fn())  # compile
+            t0 = time.time()
+            n = 5
+            for _ in range(n):
+                out = fn()
+            jax.block_until_ready(out)
+            ms = (time.time() - t0) / n * 1e3
+            gb = (R2 * F2 * 1 + R2 * 3 * 4) / 1e9
+            print(f"step2[{impl}]: {ms:.1f} ms/build "
+                  f"~{gb / (ms / 1e3):.0f} GB/s effective", flush=True)
+    except Exception as e:
+        print(f"step2: FAIL {type(e).__name__}: {e}", flush=True)
+        fails += 1
+
+    # -- step 3: end-to-end training with auto impl
+    try:
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.ops.histogram import resolve_impl
+        impl = resolve_impl("auto")
+        X = np.asarray(rng.standard_normal((100_000, 20)), np.float32)
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+        t0 = time.time()
+        bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                         "verbose": -1}, lgb.Dataset(X, label=y), 5)
+        p = bst.predict(X[:4096])
+        acc = float((np.asarray(p > 0.5, np.float32)
+                     == y[:4096]).mean())
+        ok = acc > 0.9
+        print(f"step3: {'PASS' if ok else 'FAIL'} auto->{impl} "
+              f"train+predict {time.time()-t0:.1f}s acc {acc:.3f}",
+              flush=True)
+        fails += 0 if ok else 1
+    except Exception as e:
+        print(f"step3: FAIL {type(e).__name__}: {e}", flush=True)
+        fails += 1
+
+    print(f"preflight: {'PASS' if fails == 0 else f'{fails} FAILURES'}",
+          flush=True)
+    return 1 if fails else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -376,3 +376,126 @@ def test_efb_feature_parallel_rollback_replays_correctly(rng):
     np.testing.assert_allclose(np.asarray(bst._gbdt.scores)[:, :n],
                                scores_after_2[:, :n],
                                rtol=1e-5, atol=1e-6)
+
+
+def test_feature_shard_storage_matches_serial(rng):
+    """feature_shard_storage=true column-shards the device bin matrix
+    ([R, F_pad/n] per chip) and resolves the partition step's bin values
+    with a one-hot psum over the feature axis — the training result must
+    equal serial exactly (numeric + categorical + NaN, odd F so the
+    feature axis needs padding)."""
+    import lightgbm_tpu as lgb
+    n, f = 4096, 21
+    X = rng.normal(size=(n, f))
+    X[rng.random(size=(n, f)) < 0.05] = np.nan
+    X[:, 5] = rng.randint(0, 12, size=n)
+    y = ((np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1])
+          + (X[:, 5] % 3 == 0)) > 0.7).astype(float)
+    common = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    mk = lambda: lgb.Dataset(X, label=y, categorical_feature=[5],  # noqa
+                             free_raw_data=False)
+    serial = lgb.train(dict(common, tree_learner="serial"), mk(), 5)
+    shard = lgb.train(dict(common, tree_learner="feature",
+                           feature_shard_storage=True), mk(), 5)
+    np.testing.assert_allclose(serial.predict(X), shard.predict(X),
+                               rtol=1e-6, atol=1e-7)
+    # the matrix must actually be column-sharded on the mesh: each
+    # device holds F_pad / n columns, not a replica
+    dd = shard._gbdt.train_dd
+    n_dev = shard._gbdt.plan.num_shards
+    F_pad = -(-f // n_dev) * n_dev
+    shapes = {s.data.shape for s in dd.bins.addressable_shards}
+    assert shapes == {(dd.bins.shape[0], F_pad // n_dev)}, shapes
+
+
+def test_feature_shard_storage_valid_early_stopping(rng):
+    """Validation matrices are column-sharded too; their co-partitioned
+    row_leaf (psum relabel) must yield the same eval metrics as serial,
+    including the early-stopping decision."""
+    import lightgbm_tpu as lgb
+    n, f = 3000, 10
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+    Xv = rng.normal(size=(1000, f))
+    yv = (Xv[:, 0] - 0.5 * Xv[:, 1] > 0).astype(float)
+    out = {}
+    for name, extra in [("serial", {"tree_learner": "serial"}),
+                        ("shard", {"tree_learner": "feature",
+                                   "feature_shard_storage": True})]:
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        dv = lgb.Dataset(Xv, label=yv, reference=ds, free_raw_data=False)
+        ev = {}
+        bst = lgb.train(dict({"objective": "binary", "num_leaves": 15,
+                              "metric": "auc", "verbosity": -1}, **extra),
+                        ds, 8, valid_sets=[dv], valid_names=["v"],
+                        callbacks=[lgb.record_evaluation(ev)])
+        out[name] = ev["v"]["auc"]
+    np.testing.assert_allclose(out["serial"], out["shard"],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_feature_shard_storage_with_efb(rng):
+    """EFB + feature_shard_storage: bundled storage decodes back to
+    per-feature columns, THEN column-shards. Result equals the
+    data-parallel EFB run."""
+    import lightgbm_tpu as lgb
+    n, F = 2048, 12
+    X = np.zeros((n, F))
+    perm = rng.permutation(n)
+    for f in range(F):
+        rows = perm[f * (n // F):(f + 1) * (n // F)]
+        X[rows, f] = rng.normal(size=len(rows)) + 1.0
+    y = (X[:, 0] - X[:, 1] + 0.3 * X[:, 2] > 0.2).astype(float)
+    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "enable_bundle": True}
+    data = lgb.train(dict(base, tree_learner="data"),
+                     lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    shard = lgb.train(dict(base, tree_learner="feature",
+                           feature_shard_storage=True),
+                      lgb.Dataset(X, label=y, free_raw_data=False), 6)
+    np.testing.assert_allclose(data.predict(X), shard.predict(X),
+                               rtol=1e-5, atol=1e-6)
+    assert shard._gbdt._unbundle_feature
+    assert shard._gbdt.plan.shard_storage
+
+
+def test_feature_shard_storage_capacity_width(rng, monkeypatch):
+    """The capacity gate divides the stored width by the shard count:
+    a matrix too wide for one device must pass once column-sharded
+    (VERDICT r4 #5 — the sharded-feature answer to wide data)."""
+    import lightgbm_tpu as lgb
+    n, f = 512, 64
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(float)
+    # budget sized so the REPLICATED working set (bins 32 KB + 4x[R]
+    # f32 per-row state 8 KB = 40 KB) fails but the column-sharded one
+    # (bins 4 KB + 8 KB = 12 KB) fits under 0.85 * 20 KB = 17 KB
+    monkeypatch.setenv("LIGHTGBM_TPU_DEVICE_MEM_GB",
+                       str(20e3 / (1 << 30)))  # ~20 KB
+    common = {"objective": "binary", "num_leaves": 4, "verbosity": -1,
+              "max_bin": 16, "hist_subtraction": False}
+    with pytest.raises(MemoryError):
+        lgb.train(dict(common, tree_learner="feature"),
+                  lgb.Dataset(X, label=y, free_raw_data=False), 1)
+    bst = lgb.train(dict(common, tree_learner="feature",
+                         feature_shard_storage=True),
+                    lgb.Dataset(X, label=y, free_raw_data=False), 1)
+    assert bst.num_trees() == 1
+
+
+def test_feature_shard_storage_rejects_dart():
+    """DART's drop/restore replay gathers whole matrix rows per stored
+    tree — on column-sharded storage that would re-materialize the full
+    [R, F] per device (the OOM the mode exists to avoid), so the combo
+    must fail fast at setup."""
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(512, 8))
+    y = (X[:, 0] > 0).astype(float)
+    with pytest.raises(NotImplementedError,
+                       match="feature_shard_storage"):
+        lgb.train({"objective": "binary", "boosting": "dart",
+                   "tree_learner": "feature",
+                   "feature_shard_storage": True, "verbosity": -1},
+                  lgb.Dataset(X, label=y, free_raw_data=False), 2)

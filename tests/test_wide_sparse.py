@@ -113,3 +113,39 @@ def test_capacity_model_and_hard_error(rng, monkeypatch):
         lgb.train({"objective": "regression", "num_leaves": 15,
                    "verbosity": -1},
                   lgb.Dataset(X, label=y, free_raw_data=False), 2)
+
+
+def test_wide_non_exclusive_trains_column_sharded(rng):
+    """Round-5 answer to the wide NON-bundleable case (the shape class
+    where EFB is powerless and dense-replicated storage exceeds one
+    chip): tree_learner=feature + feature_shard_storage column-shards
+    the matrix so each device stores only F/n columns, and training
+    still matches the serial result exactly. The budget hook proves the
+    replicated layout would NOT have fit the same device."""
+    from lightgbm_tpu.dataset import estimate_device_bytes
+    n_rows, n_cols = 4_096, 512
+    mask = rng.rand(n_rows, n_cols) < 0.3       # non-exclusive: no EFB
+    vals = rng.normal(size=(n_rows, n_cols)) * mask
+    X = scipy_sparse.csr_matrix(vals)
+    y = (vals[:, 0] * 2.0 + vals[:, 1:4].sum(axis=1)
+         + 0.1 * rng.normal(size=n_rows))
+    common = {"objective": "regression", "num_leaves": 15,
+              "verbosity": -1, "max_bin": 63}
+    serial = lgb.train(dict(common, tree_learner="serial"),
+                       lgb.Dataset(X, label=y, free_raw_data=False), 5)
+    shard = lgb.train(dict(common, tree_learner="feature",
+                           feature_shard_storage=True),
+                      lgb.Dataset(X, label=y, free_raw_data=False), 5)
+    np.testing.assert_allclose(serial.predict(X[:1000]),
+                               shard.predict(X[:1000]),
+                               rtol=1e-5, atol=1e-6)
+    dd = shard._gbdt.train_dd
+    n_dev = shard._gbdt.plan.num_shards
+    shapes = {s.data.shape for s in dd.bins.addressable_shards}
+    assert shapes == {(dd.bins.shape[0], n_cols // n_dev)}
+    # the capacity arithmetic this mode unlocks: per-device width F/n
+    # is ~n x less than replicated F at the same rows
+    rep = estimate_device_bytes(n_rows, n_cols, 1, 15, 63, False, 1)
+    shd = estimate_device_bytes(n_rows, n_cols // n_dev, 1, 15, 63,
+                                False, 1)
+    assert shd < rep / 4
