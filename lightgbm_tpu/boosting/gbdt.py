@@ -219,6 +219,12 @@ class GBDT:
                 cap = max(256, 1 << int(np.floor(np.log2(
                     max(1, per_shard // 4)))))
                 self.block = min(self.block, cap)
+        elif config.feature_shard_storage:
+            from .. import log as _log
+            _log.warning(
+                "feature_shard_storage needs tree_learner=feature and "
+                "more than one device "
+                f"({n_dev} visible); storing the matrix unsharded")
         # column-sharded storage keeps only the local feature slice of
         # the matrix AND the hist cache per device: one divisor feeds
         # both the hist-sub gate and the capacity gate below

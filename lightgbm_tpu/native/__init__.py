@@ -128,6 +128,26 @@ def capi_lib():
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int64), _DOUBLE_P]
+        lib.LGBM_BoosterPredictForMatSingleRow.restype = ctypes.c_int
+        lib.LGBM_BoosterPredictForMatSingleRow.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), _DOUBLE_P]
+        lib.LGBM_BoosterPredictForCSR.restype = ctypes.c_int
+        lib.LGBM_BoosterPredictForCSR.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64), _DOUBLE_P]
+        for g in ("LGBM_BoosterGetCurrentIteration",
+                  "LGBM_BoosterNumModelPerIteration",
+                  "LGBM_BoosterNumberOfTotalModel"):
+            fn = getattr(lib, g)
+            fn.restype = ctypes.c_int
+            fn.argtypes = [ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_int)]
         _CAPI = lib
     except Exception:
         _CAPI = None

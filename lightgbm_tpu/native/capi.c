@@ -31,6 +31,8 @@
 
 #define C_API_DTYPE_FLOAT32 (0)
 #define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32 (2)
+#define C_API_DTYPE_INT64 (3)
 
 #define C_API_PREDICT_NORMAL (0)
 #define C_API_PREDICT_RAW_SCORE (1)
@@ -466,6 +468,70 @@ static int tree_leaf(const CTree *t, const double *row) {
     }
 }
 
+/* resolve the [start_iteration, num_iteration) request into a tree
+ * range; shared by every predict entry point */
+static int tree_range(const CBooster *b, int start_iteration,
+                      int num_iteration, int *t0, int *t1,
+                      int *use_iters) {
+    int tpi = b->num_tpi > 0 ? b->num_tpi : 1;
+    int iters = b->num_trees / tpi;
+    if (start_iteration < 0 || start_iteration > iters)
+        return set_err("bad start_iteration");
+    int ui = (num_iteration <= 0) ? iters - start_iteration
+                                  : num_iteration;
+    if (start_iteration + ui > iters)
+        ui = iters - start_iteration;
+    *t0 = start_iteration * tpi;
+    *t1 = (start_iteration + ui) * tpi;
+    *use_iters = ui;
+    return LGBM_API_OK;
+}
+
+/* one dense row -> leaf indices (t1-t0 values) or transformed scores
+ * (num_class values); acc is caller scratch of num_class doubles */
+static void predict_row(const CBooster *b, const double *row,
+                        int t0, int t1, int use_iters, int predict_type,
+                        double *acc, double *out) {
+    int tpi = b->num_tpi > 0 ? b->num_tpi : 1;
+    if (predict_type == C_API_PREDICT_LEAF_INDEX) {
+        for (int t = t0; t < t1; t++)
+            out[t - t0] = (double)tree_leaf(&b->trees[t], row);
+        return;
+    }
+    for (int k = 0; k < b->num_class; k++) acc[k] = 0.0;
+    for (int t = t0; t < t1; t++)
+        acc[t % tpi] +=
+            b->trees[t].leaf_value[tree_leaf(&b->trees[t], row)];
+    if (b->average_output && use_iters > 0)
+        for (int k = 0; k < b->num_class; k++) acc[k] /= use_iters;
+    if (predict_type == C_API_PREDICT_NORMAL) {
+        if (b->obj == 1 || b->obj == 3) {
+            for (int k = 0; k < b->num_class; k++)
+                acc[k] = 1.0 / (1.0 + exp(-b->sigmoid * acc[k]));
+        } else if (b->obj == 2) {
+            double mx = acc[0];
+            for (int k = 1; k < b->num_class; k++)
+                if (acc[k] > mx) mx = acc[k];
+            double s = 0.0;
+            for (int k = 0; k < b->num_class; k++) {
+                acc[k] = exp(acc[k] - mx);
+                s += acc[k];
+            }
+            for (int k = 0; k < b->num_class; k++) acc[k] /= s;
+        } else if (b->obj == 4) {
+            for (int k = 0; k < b->num_class; k++)
+                acc[k] = exp(acc[k]);
+        } else if (b->obj == 5) {   /* xentlambda */
+            for (int k = 0; k < b->num_class; k++)
+                acc[k] = 1.0 - exp(-exp(acc[k]));
+        } else if (b->obj == 6) {   /* regression sqrt */
+            for (int k = 0; k < b->num_class; k++)
+                acc[k] = (acc[k] >= 0 ? 1.0 : -1.0) * acc[k] * acc[k];
+        }
+    }
+    for (int k = 0; k < b->num_class; k++) out[k] = acc[k];
+}
+
 int LGBM_BoosterPredictForMat(void *handle, const void *data,
                               int data_type, int32_t nrow, int32_t ncol,
                               int is_row_major, int predict_type,
@@ -478,15 +544,12 @@ int LGBM_BoosterPredictForMat(void *handle, const void *data,
     if (!is_row_major) return set_err("only row-major input supported");
     if (ncol != b->max_feature_idx + 1)
         return set_err("wrong number of feature columns");
-    int tpi = b->num_tpi > 0 ? b->num_tpi : 1;
-    int iters = b->num_trees / tpi;
-    if (start_iteration < 0 || start_iteration > iters)
-        return set_err("bad start_iteration");
-    int use_iters = (num_iteration <= 0) ? iters - start_iteration
-                                         : num_iteration;
-    if (start_iteration + use_iters > iters)
-        use_iters = iters - start_iteration;
-    int t0 = start_iteration * tpi, t1 = (start_iteration + use_iters) * tpi;
+    int t0, t1, use_iters;
+    if (tree_range(b, start_iteration, num_iteration, &t0, &t1,
+                   &use_iters) != LGBM_API_OK)
+        return LGBM_API_ERR;
+    int w = (predict_type == C_API_PREDICT_LEAF_INDEX) ? t1 - t0
+                                                       : b->num_class;
 
     double *row = (double *)malloc(sizeof(double) * (size_t)ncol);
     double *acc = (double *)malloc(sizeof(double) * (size_t)b->num_class);
@@ -503,49 +566,117 @@ int LGBM_BoosterPredictForMat(void *handle, const void *data,
             free(row); free(acc);
             return set_err("data_type must be float32(0)/float64(1)");
         }
-        if (predict_type == C_API_PREDICT_LEAF_INDEX) {
-            for (int t = t0; t < t1; t++)
-                out_result[(size_t)r * (t1 - t0) + (t - t0)] =
-                    (double)tree_leaf(&b->trees[t], row);
-            continue;
-        }
-        for (int k = 0; k < b->num_class; k++) acc[k] = 0.0;
-        for (int t = t0; t < t1; t++)
-            acc[t % tpi] +=
-                b->trees[t].leaf_value[tree_leaf(&b->trees[t], row)];
-        if (b->average_output && use_iters > 0)
-            for (int k = 0; k < b->num_class; k++) acc[k] /= use_iters;
-        if (predict_type == C_API_PREDICT_NORMAL) {
-            if (b->obj == 1 || b->obj == 3) {
-                for (int k = 0; k < b->num_class; k++)
-                    acc[k] = 1.0 / (1.0 + exp(-b->sigmoid * acc[k]));
-            } else if (b->obj == 2) {
-                double mx = acc[0];
-                for (int k = 1; k < b->num_class; k++)
-                    if (acc[k] > mx) mx = acc[k];
-                double s = 0.0;
-                for (int k = 0; k < b->num_class; k++) {
-                    acc[k] = exp(acc[k] - mx);
-                    s += acc[k];
-                }
-                for (int k = 0; k < b->num_class; k++) acc[k] /= s;
-            } else if (b->obj == 4) {
-                for (int k = 0; k < b->num_class; k++)
-                    acc[k] = exp(acc[k]);
-            } else if (b->obj == 5) {   /* xentlambda */
-                for (int k = 0; k < b->num_class; k++)
-                    acc[k] = 1.0 - exp(-exp(acc[k]));
-            } else if (b->obj == 6) {   /* regression sqrt */
-                for (int k = 0; k < b->num_class; k++)
-                    acc[k] = (acc[k] >= 0 ? 1.0 : -1.0) * acc[k] * acc[k];
-            }
-        }
-        for (int k = 0; k < b->num_class; k++)
-            out_result[(size_t)r * b->num_class + k] = acc[k];
+        predict_row(b, row, t0, t1, use_iters, predict_type, acc,
+                    out_result + (size_t)r * w);
     }
     free(row); free(acc);
-    *out_len = (predict_type == C_API_PREDICT_LEAF_INDEX)
-                   ? (int64_t)nrow * (t1 - t0)
-                   : (int64_t)nrow * b->num_class;
+    *out_len = (int64_t)nrow * w;
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterPredictForMatSingleRow(void *handle, const void *data,
+                                       int data_type, int32_t ncol,
+                                       int is_row_major, int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char *parameter,
+                                       int64_t *out_len,
+                                       double *out_result) {
+    /* c_api.cpp LGBM_BoosterPredictForMatSingleRow — the serving fast
+     * path; same contract as ForMat with nrow == 1 */
+    return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                     is_row_major, predict_type,
+                                     start_iteration, num_iteration,
+                                     parameter, out_len, out_result);
+}
+
+int LGBM_BoosterPredictForCSR(void *handle, const void *indptr,
+                              int indptr_type, const int32_t *indices,
+                              const void *data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char *parameter, int64_t *out_len,
+                              double *out_result) {
+    /* c_api.cpp LGBM_BoosterPredictForCSR: sparse rows densify to the
+     * feature buffer (absent entries are 0.0, which MissingType::Zero
+     * then treats as missing — reference semantics) */
+    (void)parameter;
+    CBooster *b = (CBooster *)handle;
+    if (!b) return set_err("null handle");
+    if (num_col < b->max_feature_idx + 1)
+        return set_err("num_col smaller than the model's feature count");
+    if (nindptr < 1) return set_err("empty indptr");
+    if (data_type != C_API_DTYPE_FLOAT32 &&
+        data_type != C_API_DTYPE_FLOAT64)
+        return set_err("data_type must be float32(0)/float64(1)");
+    int t0, t1, use_iters;
+    if (tree_range(b, start_iteration, num_iteration, &t0, &t1,
+                   &use_iters) != LGBM_API_OK)
+        return LGBM_API_ERR;
+    int w = (predict_type == C_API_PREDICT_LEAF_INDEX) ? t1 - t0
+                                                       : b->num_class;
+    int ncol = b->max_feature_idx + 1;
+    int64_t nrow = nindptr - 1;
+
+    double *row = (double *)malloc(sizeof(double) * (size_t)ncol);
+    double *acc = (double *)malloc(sizeof(double) * (size_t)b->num_class);
+    if (!row || !acc) { free(row); free(acc); return set_err("oom"); }
+
+    for (int64_t r = 0; r < nrow; r++) {
+        int64_t lo, hi;
+        if (indptr_type == C_API_DTYPE_INT32) {
+            lo = ((const int32_t *)indptr)[r];
+            hi = ((const int32_t *)indptr)[r + 1];
+        } else if (indptr_type == C_API_DTYPE_INT64) {
+            lo = ((const int64_t *)indptr)[r];
+            hi = ((const int64_t *)indptr)[r + 1];
+        } else {
+            free(row); free(acc);
+            return set_err("indptr_type must be int32(2)/int64(3)");
+        }
+        if (lo < 0 || hi < lo || hi > nelem) {
+            free(row); free(acc);
+            return set_err("indptr out of range");
+        }
+        for (int c = 0; c < ncol; c++) row[c] = 0.0;
+        for (int64_t i = lo; i < hi; i++) {
+            int32_t c = indices[i];
+            if (c < 0 || c >= num_col) {
+                free(row); free(acc);
+                return set_err("column index out of range");
+            }
+            if (c >= ncol) continue;   /* feature unused by the model */
+            row[c] = (data_type == C_API_DTYPE_FLOAT64)
+                         ? ((const double *)data)[i]
+                         : (double)((const float *)data)[i];
+        }
+        predict_row(b, row, t0, t1, use_iters, predict_type, acc,
+                    out_result + (size_t)r * w);
+    }
+    free(row); free(acc);
+    *out_len = nrow * w;
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterGetCurrentIteration(void *handle, int *out_iteration) {
+    CBooster *b = (CBooster *)handle;
+    if (!b || !out_iteration) return set_err("null handle");
+    int tpi = b->num_tpi > 0 ? b->num_tpi : 1;
+    *out_iteration = b->num_trees / tpi;
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterNumModelPerIteration(void *handle, int *out_tpi) {
+    CBooster *b = (CBooster *)handle;
+    if (!b || !out_tpi) return set_err("null handle");
+    *out_tpi = b->num_tpi > 0 ? b->num_tpi : 1;
+    return LGBM_API_OK;
+}
+
+int LGBM_BoosterNumberOfTotalModel(void *handle, int *out_models) {
+    CBooster *b = (CBooster *)handle;
+    if (!b || !out_models) return set_err("null handle");
+    *out_models = b->num_trees;
     return LGBM_API_OK;
 }

@@ -15,6 +15,8 @@ extern "C" {
 
 #define C_API_DTYPE_FLOAT32 (0)
 #define C_API_DTYPE_FLOAT64 (1)
+#define C_API_DTYPE_INT32 (2)
+#define C_API_DTYPE_INT64 (3)
 
 #define C_API_PREDICT_NORMAL (0)     /* transformed scores */
 #define C_API_PREDICT_RAW_SCORE (1)  /* raw margins */
@@ -41,6 +43,36 @@ int LGBM_BoosterPredictForMat(void *handle, const void *data,
                               int start_iteration, int num_iteration,
                               const char *parameter, int64_t *out_len,
                               double *out_result);
+
+/* Serving fast path: one dense row (c_api.cpp
+ * LGBM_BoosterPredictForMatSingleRow). */
+int LGBM_BoosterPredictForMatSingleRow(void *handle, const void *data,
+                                       int data_type, int32_t ncol,
+                                       int is_row_major, int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char *parameter,
+                                       int64_t *out_len,
+                                       double *out_result);
+
+/* Predict for CSR rows (c_api.cpp LGBM_BoosterPredictForCSR): absent
+ * entries are 0.0 (missing under MissingType::Zero, like the
+ * reference). `indptr` is int32 or int64 per `indptr_type`
+ * (C_API_DTYPE_INT32/INT64); `nindptr` counts indptr entries (rows+1);
+ * `num_col` must cover the model's feature count. */
+int LGBM_BoosterPredictForCSR(void *handle, const void *indptr,
+                              int indptr_type, const int32_t *indices,
+                              const void *data, int data_type,
+                              int64_t nindptr, int64_t nelem,
+                              int64_t num_col, int predict_type,
+                              int start_iteration, int num_iteration,
+                              const char *parameter, int64_t *out_len,
+                              double *out_result);
+
+/* Model introspection (c_api.cpp analogs). */
+int LGBM_BoosterGetCurrentIteration(void *handle, int *out_iteration);
+int LGBM_BoosterNumModelPerIteration(void *handle, int *out_tpi);
+int LGBM_BoosterNumberOfTotalModel(void *handle, int *out_models);
 
 #ifdef __cplusplus
 }

@@ -268,3 +268,134 @@ def test_capi_rejects_linear_tree_models(capi, rng, tmp_path):
         str(path).encode(), ctypes.byref(iters), ctypes.byref(handle))
     assert rc == -1
     assert b"linear" in capi.LGBM_GetLastError()
+
+
+def test_capi_csr_and_single_row(capi, rng, tmp_path):
+    """LGBM_BoosterPredictForCSR densifies sparse rows (absent == 0.0,
+    missing under MissingType::Zero like the reference) and must agree
+    exactly with the dense ForMat path on the same rows;
+    PredictForMatSingleRow must agree row-by-row."""
+    import scipy.sparse as sp
+    import lightgbm_tpu as lgb
+    n, f = 2000, 8
+    mask = rng.rand(n, f) < 0.4
+    vals = rng.normal(size=(n, f)) * mask
+    y = (vals[:, 0] + vals[:, 1] > 0.2).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "zero_as_missing": True},
+                    lgb.Dataset(vals, label=y, free_raw_data=False), 8)
+    mp = tmp_path / "m.txt"
+    bst.save_model(str(mp))
+    handle, _ = _c_load(capi, mp)
+
+    dense = _c_predict(capi, handle, vals[:200], 1)
+
+    X = sp.csr_matrix(vals[:200])
+    indptr = np.asarray(X.indptr, np.int64)
+    indices = np.asarray(X.indices, np.int32)
+    data = np.asarray(X.data, np.float64)
+    out = np.zeros(200, np.float64)
+    out_len = ctypes.c_int64()
+    rc = capi.LGBM_BoosterPredictForCSR(
+        handle, indptr.ctypes.data_as(ctypes.c_void_p), 3,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(f), 0, 0, -1, b"", ctypes.byref(out_len), out)
+    assert rc == 0, capi.LGBM_GetLastError()
+    assert out_len.value == 200
+    np.testing.assert_array_equal(out, dense[:, 0])
+
+    # int32 indptr variant
+    indptr32 = np.asarray(X.indptr, np.int32)
+    out32 = np.zeros(200, np.float64)
+    rc = capi.LGBM_BoosterPredictForCSR(
+        handle, indptr32.ctypes.data_as(ctypes.c_void_p), 2,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(len(indptr32)), ctypes.c_int64(len(data)),
+        ctypes.c_int64(f), 0, 0, -1, b"", ctypes.byref(out_len), out32)
+    assert rc == 0, capi.LGBM_GetLastError()
+    np.testing.assert_array_equal(out32, dense[:, 0])
+
+    # single-row fast path
+    row = np.ascontiguousarray(vals[7], np.float64)
+    out1 = np.zeros(1, np.float64)
+    rc = capi.LGBM_BoosterPredictForMatSingleRow(
+        handle, row.ctypes.data_as(ctypes.c_void_p), 1, f, 1, 0, 0, -1,
+        b"", ctypes.byref(out_len), out1)
+    assert rc == 0, capi.LGBM_GetLastError()
+    assert out1[0] == dense[7, 0]
+
+    capi.LGBM_BoosterFree(handle)
+
+
+def test_capi_model_introspection(capi, rng, tmp_path):
+    """GetCurrentIteration / NumModelPerIteration / NumberOfTotalModel
+    mirror c_api.cpp's getters for a multiclass model."""
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(600, 5))
+    y = rng.randint(0, 3, size=600).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 4)
+    mp = tmp_path / "m.txt"
+    bst.save_model(str(mp))
+    handle, iters = _c_load(capi, mp)
+    v = ctypes.c_int()
+    assert capi.LGBM_BoosterGetCurrentIteration(handle,
+                                                ctypes.byref(v)) == 0
+    assert v.value == 4 == iters
+    assert capi.LGBM_BoosterNumModelPerIteration(handle,
+                                                 ctypes.byref(v)) == 0
+    assert v.value == 3
+    assert capi.LGBM_BoosterNumberOfTotalModel(handle,
+                                               ctypes.byref(v)) == 0
+    assert v.value == 12
+    capi.LGBM_BoosterFree(handle)
+
+
+def test_capi_csr_error_paths(capi, rng, tmp_path):
+    """CSR validation: bad indptr range and out-of-range column indices
+    fail cleanly instead of reading out of bounds."""
+    import lightgbm_tpu as lgb
+    X = rng.normal(size=(300, 4))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 2)
+    mp = tmp_path / "m.txt"
+    bst.save_model(str(mp))
+    handle, _ = _c_load(capi, mp)
+    out = np.zeros(2, np.float64)
+    out_len = ctypes.c_int64()
+    data = np.asarray([1.0, 2.0], np.float64)
+    # indptr exceeding nelem
+    indptr = np.asarray([0, 5], np.int64)
+    indices = np.asarray([0, 1], np.int32)
+    rc = capi.LGBM_BoosterPredictForCSR(
+        handle, indptr.ctypes.data_as(ctypes.c_void_p), 3,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(2), ctypes.c_int64(2), ctypes.c_int64(4),
+        0, 0, -1, b"", ctypes.byref(out_len), out)
+    assert rc != 0
+    # column index past num_col
+    indptr = np.asarray([0, 2], np.int64)
+    indices = np.asarray([0, 9], np.int32)
+    rc = capi.LGBM_BoosterPredictForCSR(
+        handle, indptr.ctypes.data_as(ctypes.c_void_p), 3,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(2), ctypes.c_int64(2), ctypes.c_int64(4),
+        0, 0, -1, b"", ctypes.byref(out_len), out)
+    assert rc != 0
+    # num_col smaller than the model's feature count
+    rc = capi.LGBM_BoosterPredictForCSR(
+        handle, indptr.ctypes.data_as(ctypes.c_void_p), 3,
+        indices.ctypes.data_as(ctypes.c_void_p),
+        data.ctypes.data_as(ctypes.c_void_p), 1,
+        ctypes.c_int64(2), ctypes.c_int64(2), ctypes.c_int64(2),
+        0, 0, -1, b"", ctypes.byref(out_len), out)
+    assert rc != 0
+    capi.LGBM_BoosterFree(handle)
