@@ -111,6 +111,18 @@ def probe_hist_impl(platform: str) -> dict:
     # toolchain exists, else scatter
     out = {"hist_impl": resolve_impl("auto") if platform == "cpu"
            else "matmul"}
+    if out["hist_impl"] == "native":
+        # the native kernel threads over (slot, row-range) chunks;
+        # record the worker count so the throughput number is
+        # interpretable next to the single-thread reference probe.
+        # Mirrors hist_ffi.cc hist_threads(): junk/absent env -> the
+        # hardware default, clamps matched
+        try:
+            t = int(os.environ.get("LIGHTGBM_TPU_NUM_THREADS", ""))
+        except ValueError:
+            t = 0
+        out["hist_native_threads"] = (min(t, 64) if t >= 1
+                                      else min(os.cpu_count() or 1, 16))
     rng = np.random.RandomState(3)
     R, F, B, L = 1 << 17, 28, 63, 21
     bins = rng.randint(0, B, size=(R, F)).astype(np.uint8)

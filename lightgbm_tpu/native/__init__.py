@@ -178,7 +178,8 @@ def hist_lib():
         inc = jax.ffi.include_dir()
         lib = _compile_and_load(
             "hist_ffi.cc", "lightgbm_tpu_hist_ffi",
-            extra_gcc=("-std=c++17", f"-I{inc}"), compiler="g++")
+            extra_gcc=("-std=c++17", "-pthread", f"-I{inc}"),
+            compiler="g++")
         jax.ffi.register_ffi_target(
             "lgbtpu_hist_f32", jax.ffi.pycapsule(lib.LgbtpuHistF32),
             platform="cpu")

@@ -24,7 +24,9 @@
 // XLA scatter formulation when the toolchain is missing.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <thread>
 #include <vector>
 #include <type_traits>
 
@@ -39,6 +41,26 @@
 namespace ffi = xla::ffi;
 
 namespace {
+
+// Worker count for the histogram kernel. LIGHTGBM_TPU_NUM_THREADS
+// overrides; default is the hardware concurrency (the reference's
+// OpenMP default, src/io/dense_bin.hpp histograms are num_threads-
+// parallel the same way). Like the reference, the float accumulation
+// ORDER depends on the worker count, so results are deterministic for
+// a fixed thread count but may differ in the last ulp across counts
+// (int8-quantized histograms stay exact regardless).
+inline int hist_threads() {
+  // re-read per call (getenv is ns next to a ms-scale kernel) so tests
+  // and callers can retune without reloading the library
+  const char* env = std::getenv("LIGHTGBM_TPU_NUM_THREADS");
+  if (env) {
+    int v = std::atoi(env);
+    if (v >= 1) return v > 64 ? 64 : v;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  int v = hw ? static_cast<int>(hw) : 1;
+  return v > 16 ? 16 : v;
+}
 
 inline float bf16_round_f(float x) {
   uint32_t u;
@@ -445,78 +467,216 @@ ffi::Error PartitionImpl(ffi::AnyBuffer bins, ffi::AnyBuffer row_leaf,
 // requested slots' segments (no scan over R, no per-row branch) — the
 // native analog of dense_bin.hpp:105 ConstructHistogram iterating
 // data_indices of one leaf.
+// Accumulate perm rows [i0, i1) of one leaf segment into a 4-channel
+// padded scratch: the per-(row,feature) update is ONE 16-byte SIMD
+// load+add+store instead of three scalar read-modify-writes (the inner
+// loop is store-port bound otherwise).
+template <typename BinT, typename GhT, typename AccT, bool kBf16>
+void perm_accum_range(const BinT* bins, const GhT* gh, const int32_t* perm,
+                      int64_t i0, int64_t i1, int64_t F, int64_t B,
+                      AccT* sc) {
+  for (int64_t i = i0; i < i1; i++) {
+    // deep leaves' rows are far apart: without prefetch the walk is
+    // DRAM-latency bound (~84 ns/row measured); overlap the misses
+    if (i + 16 < i1) {
+      const int64_t rp = perm[i + 16];
+      __builtin_prefetch(bins + rp * F);
+      __builtin_prefetch(bins + rp * F + F - 1);   // row may straddle
+      __builtin_prefetch(gh + rp * 3);
+    }
+    const int64_t r = perm[i];
+    AccT g = static_cast<AccT>(gh[r * 3]);
+    AccT h = static_cast<AccT>(gh[r * 3 + 1]);
+    AccT cc = static_cast<AccT>(gh[r * 3 + 2]);
+    if (kBf16) {
+      g = bf16_round_f(g);
+      h = bf16_round_f(h);
+      cc = bf16_round_f(cc);
+    }
+    const BinT* br = bins + r * F;
+#if LGBTPU_SSE2
+    alignas(16) AccT ghq[4] = {g, h, cc, AccT(0)};
+    __m128 ghv_f = _mm_setzero_ps();
+    __m128i ghv_i = _mm_setzero_si128();
+    if constexpr (std::is_floating_point<AccT>::value)
+      ghv_f = _mm_load_ps(reinterpret_cast<const float*>(ghq));
+    else
+      ghv_i = _mm_load_si128(reinterpret_cast<const __m128i*>(ghq));
+#endif
+    for (int64_t f = 0; f < F; f++) {
+      const int64_t bv = static_cast<int64_t>(br[f]);
+      if (bv < 0 || bv >= B) continue;
+      AccT* cell = sc + (f * B + bv) * 4;
+#if LGBTPU_SSE2
+      if constexpr (std::is_floating_point<AccT>::value) {
+        float* cf = reinterpret_cast<float*>(cell);
+        _mm_storeu_ps(cf, _mm_add_ps(_mm_loadu_ps(cf), ghv_f));
+      } else {
+        __m128i* ci = reinterpret_cast<__m128i*>(cell);
+        _mm_storeu_si128(
+            ci, _mm_add_epi32(_mm_loadu_si128(ci), ghv_i));
+      }
+#else
+      cell[0] += g;
+      cell[1] += h;
+      cell[2] += cc;
+#endif
+    }
+  }
+}
+
 template <typename BinT, typename GhT, typename AccT, bool kBf16>
 void hist_perm_core(const BinT* bins, const GhT* gh, const int32_t* perm,
                     const int32_t* begin, const int32_t* cnt,
                     int64_t n_slots, const int32_t* leaf_ids, int64_t S,
                     int64_t R, int64_t F, int64_t B, AccT* out) {
   const int64_t FB3 = F * B * 3;
-  // accumulate into a 4-channel padded scratch so the per-(row,feature)
-  // update is ONE 16-byte SIMD load+add+store instead of three scalar
-  // read-modify-writes (the inner loop is store-port bound otherwise);
-  // folded back to the tight 3-channel layout per slot
-  std::vector<AccT> scratch;
+  const size_t FB4 = static_cast<size_t>(F * B * 4);
+
+  // (slot, row-range) chunks; threads take chunks STATICALLY (t, t+T,
+  // t+2T, ...) into per-thread per-slot scratches so the accumulation
+  // order — and therefore the float result — is deterministic for a
+  // fixed thread count (the reference's OpenMP histograms share this
+  // contract)
+  struct Chunk { int32_t j; int64_t i0, i1; };
+  int64_t total = 0;
+  for (int64_t j = 0; j < S; j++) {
+    const int32_t s = leaf_ids[j];
+    if (s < 0 || s >= n_slots) continue;
+    const int64_t c = cnt[s];
+    const int64_t b = begin[s];
+    if (b < 0 || c <= 0 || b + c > R) continue;
+    total += c;
+  }
+  int T = hist_threads();
+  // thread spawn+join costs O(100 us); stay serial until the work
+  // dwarfs it (a 256k-row pass is ~ms-scale)
+  if (total < (int64_t{1} << 18)) T = 1;
+  // bound the worst-case scratch set (every thread touching every
+  // slot) to ~1 GiB so wide lattices shed workers instead of paging
+  const int64_t per_thread_worst =
+      S * static_cast<int64_t>(FB4) * sizeof(AccT);
+  const int64_t t_mem = (int64_t{1} << 30) /
+                        (per_thread_worst > 0 ? per_thread_worst : 1);
+  if (t_mem < T) T = t_mem < 1 ? 1 : static_cast<int>(t_mem);
+  const int64_t csz = total / (static_cast<int64_t>(T) * 8) + 1;
+  const int64_t chunk = csz < 16384 ? 16384 : csz;
+  std::vector<Chunk> chunks;
   for (int64_t j = 0; j < S; j++) {
     const int32_t s = leaf_ids[j];
     if (s < 0 || s >= n_slots) continue;
     const int64_t b = begin[s];
     const int64_t c = cnt[s];
     if (b < 0 || c <= 0 || b + c > R) continue;
-    scratch.assign(static_cast<size_t>(F * B * 4), AccT(0));
-    AccT* sc = scratch.data();
-    for (int64_t i = b; i < b + c; i++) {
-      // deep leaves' rows are far apart: without prefetch the walk is
-      // DRAM-latency bound (~84 ns/row measured); overlap the misses
-      if (i + 16 < b + c) {
-        const int64_t rp = perm[i + 16];
-        __builtin_prefetch(bins + rp * F);
-        __builtin_prefetch(bins + rp * F + F - 1);   // row may straddle
-        __builtin_prefetch(gh + rp * 3);
+    for (int64_t i0 = b; i0 < b + c; i0 += chunk) {
+      const int64_t i1 = (i0 + chunk < b + c) ? i0 + chunk : b + c;
+      chunks.push_back({static_cast<int32_t>(j), i0, i1});
+    }
+  }
+  if (T > static_cast<int>(chunks.size()))
+    T = static_cast<int>(chunks.size());
+
+  if (T <= 1) {
+    // serial: one scratch reused slot-by-slot (chunks of a slot are
+    // consecutive), numerically identical to the pre-threading kernel
+    std::vector<AccT> scratch(FB4, AccT(0));
+    int32_t cur = -1;
+    auto fold = [&](int32_t j) {
+      AccT* hb = out + static_cast<int64_t>(j) * FB3;
+      const AccT* sc = scratch.data();
+      for (int64_t k = 0; k < F * B; k++) {
+        hb[k * 3] = sc[k * 4];
+        hb[k * 3 + 1] = sc[k * 4 + 1];
+        hb[k * 3 + 2] = sc[k * 4 + 2];
       }
-      const int64_t r = perm[i];
-      AccT g = static_cast<AccT>(gh[r * 3]);
-      AccT h = static_cast<AccT>(gh[r * 3 + 1]);
-      AccT cc = static_cast<AccT>(gh[r * 3 + 2]);
-      if (kBf16) {
-        g = bf16_round_f(g);
-        h = bf16_round_f(h);
-        cc = bf16_round_f(cc);
+    };
+    for (const Chunk& ck : chunks) {
+      if (ck.j != cur) {
+        if (cur >= 0) fold(cur);
+        std::fill(scratch.begin(), scratch.end(), AccT(0));
+        cur = ck.j;
       }
-      const BinT* br = bins + r * F;
-#if LGBTPU_SSE2
-      alignas(16) AccT ghq[4] = {g, h, cc, AccT(0)};
-      __m128 ghv_f = _mm_setzero_ps();
-      __m128i ghv_i = _mm_setzero_si128();
-      if constexpr (std::is_floating_point<AccT>::value)
-        ghv_f = _mm_load_ps(reinterpret_cast<const float*>(ghq));
-      else
-        ghv_i = _mm_load_si128(reinterpret_cast<const __m128i*>(ghq));
-#endif
-      for (int64_t f = 0; f < F; f++) {
-        const int64_t bv = static_cast<int64_t>(br[f]);
-        if (bv < 0 || bv >= B) continue;
-        AccT* cell = sc + (f * B + bv) * 4;
-#if LGBTPU_SSE2
-        if constexpr (std::is_floating_point<AccT>::value) {
-          float* cf = reinterpret_cast<float*>(cell);
-          _mm_storeu_ps(cf, _mm_add_ps(_mm_loadu_ps(cf), ghv_f));
-        } else {
-          __m128i* ci = reinterpret_cast<__m128i*>(cell);
-          _mm_storeu_si128(
-              ci, _mm_add_epi32(_mm_loadu_si128(ci), ghv_i));
-        }
-#else
-        cell[0] += g;
-        cell[1] += h;
-        cell[2] += cc;
-#endif
+      perm_accum_range<BinT, GhT, AccT, kBf16>(bins, gh, perm, ck.i0,
+                                               ck.i1, F, B,
+                                               scratch.data());
+    }
+    if (cur >= 0) fold(cur);
+    return;
+  }
+
+  // parallel: per-thread per-slot scratches, folded slot-major after
+  // the join (fold order fixed: thread 0, 1, ...). All scratches are
+  // allocated HERE, before any thread exists: an allocation failure
+  // inside a worker would escape as std::terminate (no catch crosses a
+  // thread boundary), while here it degrades to the serial tail below.
+  std::vector<std::vector<std::vector<AccT>>> sc_t(
+      static_cast<size_t>(T));
+  try {
+    for (int t = 0; t < T; t++) {
+      sc_t[static_cast<size_t>(t)].resize(static_cast<size_t>(S));
+      for (size_t k = static_cast<size_t>(t); k < chunks.size();
+           k += static_cast<size_t>(T)) {
+        auto& sc = sc_t[static_cast<size_t>(t)][
+            static_cast<size_t>(chunks[k].j)];
+        if (sc.empty()) sc.assign(FB4, AccT(0));
       }
     }
+  } catch (const std::bad_alloc&) {
+    // scratch set does not fit: fall back to one thread's worth
+    sc_t.assign(1, {});
+    sc_t[0].resize(static_cast<size_t>(S));
+    for (const Chunk& ck : chunks) {
+      auto& sc = sc_t[0][static_cast<size_t>(ck.j)];
+      if (sc.empty()) sc.assign(FB4, AccT(0));  // S scratches: required
+    }
+    T = 1;
+  }
+  auto run_worker = [&](int t) {
+    auto& mine = sc_t[static_cast<size_t>(t)];
+    for (size_t k = static_cast<size_t>(t); k < chunks.size();
+         k += static_cast<size_t>(T)) {
+      const Chunk& ck = chunks[k];
+      perm_accum_range<BinT, GhT, AccT, kBf16>(
+          bins, gh, perm, ck.i0, ck.i1, F, B,
+          mine[static_cast<size_t>(ck.j)].data());
+    }
+  };
+  std::vector<std::thread> workers;
+  workers.reserve(static_cast<size_t>(T));
+  int spawned = 0;
+  try {
+    for (int t = 1; t < T; t++) {
+      workers.emplace_back(run_worker, t);
+      spawned++;
+    }
+  } catch (...) {
+    // resource exhaustion spawning workers: the unspawned indices run
+    // on this thread below, so every chunk is still processed exactly
+    // once into its own scratch
+  }
+  run_worker(0);
+  for (int t = spawned + 1; t < T; t++) run_worker(t);
+  for (auto& w : workers) w.join();
+  for (int64_t j = 0; j < S; j++) {
     AccT* hb = out + j * FB3;
-    for (int64_t k = 0; k < F * B; k++) {
-      hb[k * 3] = sc[k * 4];
-      hb[k * 3 + 1] = sc[k * 4 + 1];
-      hb[k * 3 + 2] = sc[k * 4 + 2];
+    bool first = true;
+    for (int t = 0; t < T; t++) {
+      const auto& sc = sc_t[static_cast<size_t>(t)][static_cast<size_t>(j)];
+      if (sc.empty()) continue;
+      if (first) {
+        for (int64_t k = 0; k < F * B; k++) {
+          hb[k * 3] = sc[k * 4];
+          hb[k * 3 + 1] = sc[k * 4 + 1];
+          hb[k * 3 + 2] = sc[k * 4 + 2];
+        }
+        first = false;
+      } else {
+        for (int64_t k = 0; k < F * B; k++) {
+          hb[k * 3] += sc[k * 4];
+          hb[k * 3 + 1] += sc[k * 4 + 1];
+          hb[k * 3 + 2] += sc[k * 4 + 2];
+        }
+      }
     }
   }
 }

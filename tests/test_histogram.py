@@ -467,3 +467,51 @@ def test_subtraction_tree_matches_direct(rng):
     np.testing.assert_allclose(trees[True][2], trees[False][2],
                                rtol=1e-5, atol=1e-6)
     np.testing.assert_array_equal(trees[True][3], trees[False][3])
+
+
+def test_native_perm_kernel_threaded_matches_serial(rng, monkeypatch):
+    """The partition-ordered histogram kernel parallelizes over
+    (slot, row-range) chunks with per-thread scratches. Quantized int8
+    accumulation is EXACT (order-free), so any thread count must be
+    bit-identical; f32 differs only by addend association, so serial vs
+    8 threads must agree to float tolerance."""
+    from lightgbm_tpu import native as N
+    if N.hist_lib() is None:
+        pytest.skip("native toolchain unavailable")
+    # R above the kernel's 2^18-row serial cutoff so the 8-thread run
+    # actually takes the parallel path
+    R, F, B, S = 600_000, 6, 16, 3
+    bins = rng.randint(0, B, size=(R, F)).astype(np.uint8)
+    # segment layout: a permutation split into S contiguous leaf runs
+    perm = rng.permutation(R).astype(np.int32)
+    begin = np.asarray([0, R // 2, 3 * R // 4], np.int32)
+    cnt = np.asarray([R // 2, R // 4, R - 3 * R // 4], np.int32)
+    lids = np.arange(S, dtype=np.int32)
+
+    def run(gh):
+        out_dt = jnp.int32 if gh.dtype == np.int8 else jnp.float32
+        target = ("lgbtpu_hist_perm_i8" if gh.dtype == np.int8
+                  else "lgbtpu_hist_perm_f32")
+        return np.asarray(jax.ffi.ffi_call(
+            target, jax.ShapeDtypeStruct((S, F, B, 3), out_dt))(
+            jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(perm),
+            jnp.asarray(begin), jnp.asarray(cnt), jnp.asarray(lids),
+            bf16_round=False))
+
+    ghf = np.stack([rng.normal(size=R), rng.uniform(0.1, 1, size=R),
+                    np.ones(R)], 1).astype(np.float32)
+    ghq = rng.randint(-100, 100, size=(R, 3)).astype(np.int8)
+
+    monkeypatch.setenv("LIGHTGBM_TPU_NUM_THREADS", "1")
+    f_serial, q_serial = run(ghf), run(ghq)
+    monkeypatch.setenv("LIGHTGBM_TPU_NUM_THREADS", "8")
+    f_par, q_par = run(ghf), run(ghq)
+
+    np.testing.assert_array_equal(q_serial, q_par)   # int32: exact
+    np.testing.assert_allclose(f_serial, f_par, rtol=1e-5, atol=1e-3)
+    # and the serial result is itself correct vs the numpy oracle
+    row_leaf = np.full(R, -1, np.int32)
+    for s in range(S):
+        row_leaf[perm[begin[s]:begin[s] + cnt[s]]] = s
+    want = build_histograms_reference(bins, ghf, row_leaf, lids, B)
+    np.testing.assert_allclose(f_serial, want, rtol=1e-4, atol=1e-2)
