@@ -10,8 +10,22 @@ import os
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Pin the CPU codegen ISA: LLVM's host-feature detection is
+# per-process state (AMX needs an arch_prctl opt-in some processes
+# make and others don't), so without a pin two test processes write
+# persistent-cache entries with INCOMPATIBLE feature sets — loading the
+# other's AOT result then warns "machine feature not supported on the
+# host" and can segfault outright (observed once in-suite, round 5).
+# AVX2 is universally present on the fleet and plenty for tests.
+if "xla_cpu_max_isa" not in flags:
+    flags = (flags + " --xla_cpu_max_isa=AVX2").strip()
+os.environ["XLA_FLAGS"] = flags
+# effective pin (ours or a caller's) — the cache dir is keyed by it
+import re  # noqa: E402
+
+_isa = re.search(r"xla_cpu_max_isa=(\w+)", flags)
+_isa = _isa.group(1).lower() if _isa else "hostisa"
 # force CPU: the session env pins JAX_PLATFORMS to the TPU tunnel platform,
 # and the env var alone does not win against it — use the config API.
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -25,8 +39,11 @@ jax.config.update("jax_platforms", "cpu")
 # unchanged-shape tests skip compilation entirely.
 _cc_dir = os.environ.get(
     "LIGHTGBM_TPU_TEST_CC",
+    # dir name carries the EFFECTIVE ISA pin: entries written before
+    # the pin, or under a different caller-provided pin, are orphaned
+    # instead of loaded
     os.path.join(os.path.expanduser("~"), ".cache",
-                 "lightgbm_tpu_test_xla"))
+                 f"lightgbm_tpu_test_xla_{_isa}"))
 try:
     os.makedirs(_cc_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", _cc_dir)
