@@ -45,6 +45,10 @@ jax.config.update("jax_platforms", "cpu")
 # moves.
 _cc_dir = os.environ.get("LIGHTGBM_TPU_TEST_CC")
 if _cc_dir:
+    # key the opt-in dir by the effective ISA pin (_isa above): one dir
+    # shared across incompatible feature sets would reintroduce the
+    # foreign-ISA load hazard the pin exists to prevent
+    _cc_dir = os.path.join(_cc_dir, _isa)
     try:
         os.makedirs(_cc_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cc_dir)
