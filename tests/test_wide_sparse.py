@@ -11,6 +11,8 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
+from conftest import SHARDED_IN_PROC as _SHARDED_IN_PROC
+from conftest import run_isolated as _run_isolated
 
 scipy_sparse = pytest.importorskip("scipy.sparse")
 
@@ -122,6 +124,9 @@ def test_wide_non_exclusive_trains_column_sharded(rng):
     the matrix so each device stores only F/n columns, and training
     still matches the serial result exactly. The budget hook proves the
     replicated layout would NOT have fit the same device."""
+    if not _SHARDED_IN_PROC:
+        _run_isolated(__file__, "test_wide_non_exclusive_trains_column_sharded")
+        return
     from lightgbm_tpu.dataset import estimate_device_bytes
     n_rows, n_cols = 4_096, 512
     mask = rng.rand(n_rows, n_cols) < 0.3       # non-exclusive: no EFB
