@@ -86,7 +86,8 @@ inline void build_lut(const int32_t* leaf_ids, int64_t L,
 template <typename BinT, typename GhT, typename AccT, bool kBf16>
 void hist_core(const BinT* bins, const GhT* gh, const int32_t* row_leaf,
                const std::vector<int32_t>& lut, const int32_t* row_gather,
-               int64_t num_rows, int64_t F, int64_t B, AccT* out) {
+               int64_t num_rows, int64_t R_bins, int64_t F, int64_t B,
+               AccT* out) {
   const int64_t lut_size = static_cast<int64_t>(lut.size());
   const int64_t FB3 = F * B * 3;
   for (int64_t r = 0; r < num_rows; r++) {
@@ -95,6 +96,7 @@ void hist_core(const BinT* bins, const GhT* gh, const int32_t* row_leaf,
     const int32_t li = lut[rl];
     if (li < 0) continue;
     const int64_t row = row_gather ? static_cast<int64_t>(row_gather[r]) : r;
+    if (row < 0 || row >= R_bins) continue;   // corrupt gather guard
     AccT g = static_cast<AccT>(gh[r * 3]);
     AccT h = static_cast<AccT>(gh[r * 3 + 1]);
     AccT c = static_cast<AccT>(gh[r * 3 + 2]);
@@ -162,17 +164,17 @@ ffi::Error HistImpl(ffi::AnyBuffer bins, ffi::AnyBuffer gh,
   if (u8) {
     if (bf16_round)
       hist_core<uint8_t, GhT, AccT, true>(
-          reinterpret_cast<const uint8_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+          reinterpret_cast<const uint8_t*>(bp), ghp, rl, lut, rg, nr, bdims[0], F, B, op);
     else
       hist_core<uint8_t, GhT, AccT, false>(
-          reinterpret_cast<const uint8_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+          reinterpret_cast<const uint8_t*>(bp), ghp, rl, lut, rg, nr, bdims[0], F, B, op);
   } else {
     if (bf16_round)
       hist_core<int32_t, GhT, AccT, true>(
-          reinterpret_cast<const int32_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+          reinterpret_cast<const int32_t*>(bp), ghp, rl, lut, rg, nr, bdims[0], F, B, op);
     else
       hist_core<int32_t, GhT, AccT, false>(
-          reinterpret_cast<const int32_t*>(bp), ghp, rl, lut, rg, nr, F, B, op);
+          reinterpret_cast<const int32_t*>(bp), ghp, rl, lut, rg, nr, bdims[0], F, B, op);
   }
   return ffi::Error::Success();
 }
@@ -358,6 +360,7 @@ void partition_core(const BinT* bins, int64_t R, int64_t F,
                                : bins + rp * F + f);
       }
       const int32_t r = seg[i];
+      if (r < 0 || r >= R) continue;   // corrupt perm: never deref/write
       const int64_t bv = static_cast<int64_t>(
           kColMajor ? bins[static_cast<int64_t>(f) * R + r]
                     : bins[static_cast<int64_t>(r) * F + f]);
@@ -370,14 +373,18 @@ void partition_core(const BinT* bins, int64_t R, int64_t F,
         rl_out[r] = rs;
       }
     }
-    // restore stable order of the right block (it was written reversed)
+    // restore stable order of the right block (it was written reversed,
+    // filling backward from b+c). Anchor at b+c-nr, NOT b+nl: when the
+    // corrupt-row guard skipped entries, nl+nr < c and stale slots sit
+    // between the blocks — the counts below exclude them so no child
+    // ever walks a stale (duplicate) row
     for (int64_t i = 0; i < nr / 2; i++) {
-      std::swap(perm_out[b + nl + i], perm_out[b + c - 1 - i]);
+      std::swap(perm_out[b + c - nr + i], perm_out[b + c - 1 - i]);
     }
     begin_out[s] = static_cast<int32_t>(b);
     cnt_out[s] = static_cast<int32_t>(nl);
-    begin_out[rs] = static_cast<int32_t>(b + nl);
-    cnt_out[rs] = static_cast<int32_t>(c - nl);
+    begin_out[rs] = static_cast<int32_t>(b + c - nr);
+    cnt_out[rs] = static_cast<int32_t>(nr);
   }
 }
 
@@ -473,8 +480,8 @@ ffi::Error PartitionImpl(ffi::AnyBuffer bins, ffi::AnyBuffer row_leaf,
 // loop is store-port bound otherwise).
 template <typename BinT, typename GhT, typename AccT, bool kBf16>
 void perm_accum_range(const BinT* bins, const GhT* gh, const int32_t* perm,
-                      int64_t i0, int64_t i1, int64_t F, int64_t B,
-                      AccT* sc) {
+                      int64_t i0, int64_t i1, int64_t R, int64_t F,
+                      int64_t B, AccT* sc) {
   for (int64_t i = i0; i < i1; i++) {
     // deep leaves' rows are far apart: without prefetch the walk is
     // DRAM-latency bound (~84 ns/row measured); overlap the misses
@@ -485,6 +492,7 @@ void perm_accum_range(const BinT* bins, const GhT* gh, const int32_t* perm,
       __builtin_prefetch(gh + rp * 3);
     }
     const int64_t r = perm[i];
+    if (r < 0 || r >= R) continue;   // corrupt perm: never deref
     AccT g = static_cast<AccT>(gh[r * 3]);
     AccT h = static_cast<AccT>(gh[r * 3 + 1]);
     AccT cc = static_cast<AccT>(gh[r * 3 + 2]);
@@ -597,7 +605,7 @@ void hist_perm_core(const BinT* bins, const GhT* gh, const int32_t* perm,
         cur = ck.j;
       }
       perm_accum_range<BinT, GhT, AccT, kBf16>(bins, gh, perm, ck.i0,
-                                               ck.i1, F, B,
+                                               ck.i1, R, F, B,
                                                scratch.data());
     }
     if (cur >= 0) fold(cur);
@@ -637,7 +645,7 @@ void hist_perm_core(const BinT* bins, const GhT* gh, const int32_t* perm,
          k += static_cast<size_t>(T)) {
       const Chunk& ck = chunks[k];
       perm_accum_range<BinT, GhT, AccT, kBf16>(
-          bins, gh, perm, ck.i0, ck.i1, F, B,
+          bins, gh, perm, ck.i0, ck.i1, R, F, B,
           mine[static_cast<size_t>(ck.j)].data());
     }
   };

@@ -92,11 +92,33 @@ def run_isolated(test_file, name, timeout=900):
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, env=env)
     except subprocess.TimeoutExpired as e:
+        # CPython attaches the partial output as BYTES even with
+        # text=True — decode so the child's traceback stays readable
+        so = (e.stdout or b"").decode(errors="replace")
+        se = (e.stderr or b"").decode(errors="replace")
         raise AssertionError(
             f"isolated test {name} hung past {timeout}s;\n"
-            f"stdout:\n{(e.stdout or b'')[-3000:]}\n"
-            f"stderr:\n{(e.stderr or b'')[-2000:]}") from None
+            f"stdout:\n{so[-3000:]}\nstderr:\n{se[-2000:]}") from None
     assert r.returncode == 0, (r.stdout[-3000:] + "\n" + r.stderr[-2000:])
+
+
+def sharded_isolated(fn):
+    """Decorator form of the isolation shim: runs the body in-process
+    only inside the child (LGBTPU_SHARDED_IN_PROC), else spawns it.
+    Derives file and test name from the function, so renames cannot
+    desynchronize a retyped string."""
+    import functools
+    import inspect
+
+    test_file = inspect.getfile(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if SHARDED_IN_PROC:
+            return fn(*args, **kwargs)
+        run_isolated(test_file, fn.__name__)
+
+    return wrapper
 
 
 def pytest_configure(config):

@@ -17,8 +17,7 @@ from lightgbm_tpu.ops.split import SplitParams
 from lightgbm_tpu.parallel.data_parallel import (DataParallelPlan,
                                                  build_tree_dp, make_mesh)
 
-from conftest import SHARDED_IN_PROC as _SHARDED_IN_PROC
-from conftest import run_isolated as _run_isolated
+from conftest import sharded_isolated as _sharded_isolated
 
 
 def _data(rng, R=1024, F=6, B=32):
@@ -382,15 +381,13 @@ def test_efb_feature_parallel_rollback_replays_correctly(rng):
                                rtol=1e-5, atol=1e-6)
 
 
+@_sharded_isolated
 def test_feature_shard_storage_matches_serial(rng):
     """feature_shard_storage=true column-shards the device bin matrix
     ([R, F_pad/n] per chip) and resolves the partition step's bin values
     with a one-hot psum over the feature axis — the training result must
     equal serial exactly (numeric + categorical + NaN, odd F so the
     feature axis needs padding)."""
-    if not _SHARDED_IN_PROC:
-        _run_isolated(__file__, "test_feature_shard_storage_matches_serial")
-        return
     import lightgbm_tpu as lgb
     n, f = 4096, 21
     X = rng.normal(size=(n, f))
@@ -416,13 +413,11 @@ def test_feature_shard_storage_matches_serial(rng):
     assert shapes == {(dd.bins.shape[0], F_pad // n_dev)}, shapes
 
 
+@_sharded_isolated
 def test_feature_shard_storage_valid_early_stopping(rng):
     """Validation matrices are column-sharded too; their co-partitioned
     row_leaf (psum relabel) must yield the same eval metrics as serial,
     including the early-stopping decision."""
-    if not _SHARDED_IN_PROC:
-        _run_isolated(__file__, "test_feature_shard_storage_valid_early_stopping")
-        return
     import lightgbm_tpu as lgb
     n, f = 3000, 10
     X = rng.normal(size=(n, f))
@@ -445,13 +440,11 @@ def test_feature_shard_storage_valid_early_stopping(rng):
                                rtol=1e-6, atol=1e-7)
 
 
+@_sharded_isolated
 def test_feature_shard_storage_with_efb(rng):
     """EFB + feature_shard_storage: bundled storage decodes back to
     per-feature columns, THEN column-shards. Result equals the
     data-parallel EFB run."""
-    if not _SHARDED_IN_PROC:
-        _run_isolated(__file__, "test_feature_shard_storage_with_efb")
-        return
     import lightgbm_tpu as lgb
     n, F = 2048, 12
     X = np.zeros((n, F))
@@ -473,13 +466,11 @@ def test_feature_shard_storage_with_efb(rng):
     assert shard._gbdt.plan.shard_storage
 
 
+@_sharded_isolated
 def test_feature_shard_storage_capacity_width(rng, monkeypatch):
     """The capacity gate divides the stored width by the shard count:
     a matrix too wide for one device must pass once column-sharded
     (VERDICT r4 #5 — the sharded-feature answer to wide data)."""
-    if not _SHARDED_IN_PROC:
-        _run_isolated(__file__, "test_feature_shard_storage_capacity_width")
-        return
     import lightgbm_tpu as lgb
     n, f = 512, 64
     X = rng.normal(size=(n, f))

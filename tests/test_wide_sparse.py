@@ -11,8 +11,7 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from conftest import SHARDED_IN_PROC as _SHARDED_IN_PROC
-from conftest import run_isolated as _run_isolated
+from conftest import sharded_isolated as _sharded_isolated
 
 scipy_sparse = pytest.importorskip("scipy.sparse")
 
@@ -117,6 +116,7 @@ def test_capacity_model_and_hard_error(rng, monkeypatch):
                   lgb.Dataset(X, label=y, free_raw_data=False), 2)
 
 
+@_sharded_isolated
 def test_wide_non_exclusive_trains_column_sharded(rng):
     """Round-5 answer to the wide NON-bundleable case (the shape class
     where EFB is powerless and dense-replicated storage exceeds one
@@ -124,9 +124,6 @@ def test_wide_non_exclusive_trains_column_sharded(rng):
     the matrix so each device stores only F/n columns, and training
     still matches the serial result exactly. The budget hook proves the
     replicated layout would NOT have fit the same device."""
-    if not _SHARDED_IN_PROC:
-        _run_isolated(__file__, "test_wide_non_exclusive_trains_column_sharded")
-        return
     from lightgbm_tpu.dataset import estimate_device_bytes
     n_rows, n_cols = 4_096, 512
     mask = rng.rand(n_rows, n_cols) < 0.3       # non-exclusive: no EFB
