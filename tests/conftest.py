@@ -86,7 +86,9 @@ def run_isolated(test_file, name, timeout=900):
     # a CI-level PYTEST_ADDOPTS (e.g. --collect-only) must not rewrite
     # the child invocation into a no-op that exits 0
     env.pop("PYTEST_ADDOPTS", None)
-    cmd = [sys.executable, "-m", "pytest", "-q", "-x", "-p",
+    # -n 0 overrides the pyproject addopts' xdist distribution: the
+    # child runs exactly one test and must execute it inline
+    cmd = [sys.executable, "-m", "pytest", "-q", "-x", "-n", "0", "-p",
            "no:cacheprovider", os.path.abspath(test_file) + "::" + name]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
