@@ -212,6 +212,29 @@ class GBDT:
                 _log.warning("feature_shard_storage only applies with "
                              "tree_learner=feature; ignoring")
             self.plan = plan_cls(top_k=int(config.top_k), **plan_kw)
+            if (plan_cls is FeatureParallelPlan
+                    and getattr(self.plan, "multi_process", False)):
+                # feature-parallel needs the FULL dataset replicated on
+                # every worker (feature_parallel_tree_learner.cpp:38).
+                # Two ways a worker's copy can silently differ: the
+                # loader auto-partitioned rows, or the caller fed each
+                # host its own shard under pre_partition=true. Both
+                # produce diverging replicas (or a cross-process trace
+                # mismatch), so verify the copies agree up front.
+                for ds_ in (train_set, *[v.construct()
+                                         for v in valid_sets]):
+                    if getattr(ds_, "auto_partitioned", False):
+                        raise ValueError(
+                            "tree_learner=feature across machines "
+                            "requires every worker to load the FULL "
+                            "dataset: pass the whole data on each "
+                            "machine with pre_partition=true (the "
+                            "loader auto-partitioned rows because "
+                            "pre_partition was false)")
+                from ..parallel.distributed import \
+                    check_replicas_identical
+                check_replicas_identical(
+                    [train_set] + [v for v in valid_sets])
             if self.plan.rows_sharded:
                 # keep the scan block well under the per-shard row count
                 # so shard-granular padding stays a small fraction

@@ -238,6 +238,9 @@ class Dataset:
         self.bundle_plan = None                     # EFB layout (efb.py)
         self.bins: Optional[np.ndarray] = None      # [num_data, F|G] int
         self.num_data: int = 0
+        # True once the multi-host loader kept only this process's row
+        # block (learners that need FULL rows per worker check this)
+        self.auto_partitioned = False
         self.num_total_features: int = 0
         self.used_features: Optional[np.ndarray] = None  # indices of
         # non-trivial features actually trained on
@@ -653,6 +656,7 @@ class Dataset:
         shard and no slicing happens."""
         if not self._multi_process() or bool(self.config.pre_partition):
             return None
+        self.auto_partitioned = True
         if self.group is not None:
             raise NotImplementedError(
                 "multi-host auto-partition does not support query/group "

@@ -60,7 +60,14 @@ def shard_rows(mesh: Mesh, arr, axis_name: str = AXIS) -> jax.Array:
 
 
 def replicate(mesh: Mesh, arr) -> jax.Array:
-    return jax.device_put(arr, NamedSharding(mesh, P()))
+    """Place ``arr`` replicated on every device of the mesh. In a
+    multi-controller run the mesh spans processes, so the global array
+    is assembled from each process's (identical) full copy — device_put
+    cannot place onto non-addressable devices."""
+    sh = NamedSharding(mesh, P())
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sh, np.asarray(arr))
+    return jax.device_put(arr, sh)
 
 
 class DataParallelPlan:
@@ -143,11 +150,7 @@ class DataParallelPlan:
         return loc[:, :num_valid]
 
     def replicate(self, arr):
-        if not self.multi_process:
-            return replicate(self.mesh, arr)
-        # every process holds the identical full array by construction
-        return jax.make_array_from_process_local_data(
-            NamedSharding(self.mesh, P()), np.asarray(arr))
+        return replicate(self.mesh, arr)   # module fn: multi-proc aware
 
     def build_tree(self, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                    is_cat_pf, feature_mask, *, num_leaves: int,
@@ -216,13 +219,15 @@ class FeatureParallelPlan:
         self.shard_storage = shard_storage
         self.num_processes = jax.process_count()
         self.multi_process = self.num_processes > 1
-        if self.multi_process:
-            # feature-parallel assumes every worker holds ALL rows
-            # (feature_parallel_tree_learner.cpp model) — incompatible
-            # with per-process row shards
+        if self.multi_process and shard_storage:
+            # cross-host column sharding would need pre-sharded loading
+            # (each host materializing only its columns); today every
+            # worker holds the full matrix like the reference's
+            # feature_parallel_tree_learner.cpp:38 model
             raise NotImplementedError(
-                "tree_learner=feature is single-host only; use "
-                "tree_learner=data for multi-host training")
+                "feature_shard_storage is single-host; multi-host "
+                "feature-parallel replicates the full matrix per "
+                "worker (set feature_shard_storage=false)")
 
     def pad_to(self, num_rows: int, block: int) -> int:
         return ((num_rows + block - 1) // block) * block
@@ -249,13 +254,17 @@ class FeatureParallelPlan:
             arr, NamedSharding(self.mesh, P(None, self.axis_name)))
 
     def shard_scores(self, local_kr):
+        # every worker holds the full score block; multi-controller runs
+        # need it assembled into a GLOBAL replicated array
+        if self.multi_process:
+            return replicate(self.mesh, np.asarray(local_kr))
         return jnp.asarray(local_kr)
 
     def host_local_cols(self, arr, num_valid: int):
         return np.asarray(arr)[:, :num_valid]
 
     def replicate(self, arr):
-        return replicate(self.mesh, arr)
+        return replicate(self.mesh, arr)   # module fn: multi-proc aware
 
     def build_tree(self, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                    is_cat_pf, feature_mask, *, num_leaves: int,
