@@ -332,6 +332,105 @@ class Booster:
             return raw
         return self._converted(raw)
 
+    def _native_raw_scores(self, X, use, lo, K):
+        """RAW [n, K] scores via the native C predictor (capi.c — the
+        reference predictor.hpp model: per-row double-precision tree
+        walks in compiled code). Used on the CPU backend where the XLA
+        lock-step ensemble walk is gather-bound; the TPU backend keeps
+        the device path. Returns None when the route does not apply —
+        callers fall through to the device/host paths. RAW only: the
+        Python side applies objective transforms, so objective coverage
+        never diverges. Handle cached per model version; invalidated by
+        training/rollback like the packed device ensemble."""
+        import jax
+        n = X.shape[0]
+        if (not use or jax.default_backend() != "cpu"
+                or self._average_output            # capi averages in-walk
+                or any(t.is_linear for t in use)
+                or n * len(use) < (1 << 14)):
+            return None
+        from .native import capi_lib
+        lib = capi_lib()
+        if lib is None:
+            return None
+        import ctypes
+        import threading
+        # handle lifecycle: ctypes calls release the GIL, so another
+        # thread may rebuild the cache mid-predict — never free a
+        # handle that could be in flight; retire it and free when the
+        # in-flight count drains (the reference's C API guards its
+        # predict path with a lock for the same reason, c_api.cpp
+        # SingleRowPredictor locks)
+        if not hasattr(self, "_capi_lock"):
+            self._capi_lock = threading.Lock()
+            self._capi_inflight = 0
+            self._capi_retired = []
+        key = ("native", self._model_version)
+        with self._capi_lock:
+            if getattr(self, "_capi_key", None) != key:
+                import os
+                import tempfile
+                fd, path = tempfile.mkstemp(suffix=".txt",
+                                            prefix="lgbtpu_capi_")
+                try:
+                    with os.fdopen(fd, "w") as f:
+                        f.write(self.model_to_string())
+                    handle = ctypes.c_void_p()
+                    iters = ctypes.c_int()
+                    rc = lib.LGBM_BoosterCreateFromModelfile(
+                        path.encode(), ctypes.byref(iters),
+                        ctypes.byref(handle))
+                finally:
+                    os.unlink(path)
+                if rc != 0:
+                    return None
+                old = getattr(self, "_capi_handle", None)
+                if old:
+                    self._capi_retired.append(old)
+                self._capi_handle = handle
+                self._capi_key = key
+                if self._capi_inflight == 0:
+                    for h in self._capi_retired:
+                        lib.LGBM_BoosterFree(h)
+                    self._capi_retired.clear()
+            h = self._capi_handle
+            self._capi_inflight += 1
+        try:
+            # whole iterations by contract (predict slices [lo:hi] in
+            # iteration multiples); map to capi's iteration window
+            start_iteration = lo // K
+            num_iteration = len(use) // K
+            Xc = np.ascontiguousarray(X, np.float64)
+            out = np.zeros(n * K, np.float64)
+            out_len = ctypes.c_int64()
+            rc = lib.LGBM_BoosterPredictForMat(
+                h, Xc.ctypes.data_as(ctypes.c_void_p),
+                1, n, X.shape[1], 1, 1,        # f64, row-major, RAW
+                start_iteration, num_iteration, b"",
+                ctypes.byref(out_len), out)
+        finally:
+            with self._capi_lock:
+                self._capi_inflight -= 1
+                if self._capi_inflight == 0 and self._capi_retired:
+                    for hr in self._capi_retired:
+                        lib.LGBM_BoosterFree(hr)
+                    self._capi_retired.clear()
+        if rc != 0 or out_len.value != n * K:
+            return None
+        return out.reshape(n, K)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_capi_handle", None):
+                from .native import capi_lib
+                lib = capi_lib()
+                if lib is not None:
+                    lib.LGBM_BoosterFree(self._capi_handle)
+                    for h in getattr(self, "_capi_retired", []):
+                        lib.LGBM_BoosterFree(h)
+        except Exception:
+            pass
+
     def _predict_host_early_stop(self, X, use, lo, K, freq, margin):
         """Host path of GBDT::PredictRaw's early-stop loop
         (gbdt_prediction.cpp:13-31): rows that clear the margin every
@@ -399,6 +498,10 @@ class Booster:
         # path in float64 — a value within f32 eps of a threshold can
         # route differently across the batch-size cutover. Per-class
         # accumulation runs in f64 on both paths.
+        if early_stop is None:
+            raw = self._native_raw_scores(X, use, lo, K)
+            if raw is not None:
+                return raw
         use_device = (len(use) > 0
                       and not any(t.is_linear for t in use)
                       and n * len(use) >= (1 << 16))

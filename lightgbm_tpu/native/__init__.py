@@ -111,7 +111,7 @@ def capi_lib():
         return None
     try:
         lib = _compile_and_load("capi.c", "lightgbm_tpu_capi",
-                                extra_gcc=("-lm",))
+                                extra_gcc=("-pthread", "-lm"))
         lib.LGBM_GetLastError.restype = ctypes.c_char_p
         lib.LGBM_BoosterCreateFromModelfile.restype = ctypes.c_int
         lib.LGBM_BoosterCreateFromModelfile.argtypes = [

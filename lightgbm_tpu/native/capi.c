@@ -17,14 +17,16 @@
  * Training from C is NOT provided — train in Python, deploy from C (or
  * use codegen.py for fully compiled models).
  *
- * Build: gcc -O3 -shared -fPIC -o liblightgbm_tpu_capi.so capi.c -lm
+ * Build: gcc -O3 -shared -fPIC -pthread -o liblightgbm_tpu_capi.so capi.c -lm
  */
 
 #include <math.h>
+#include <pthread.h>
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <unistd.h>
 
 #define LGBM_API_OK 0
 #define LGBM_API_ERR (-1)
@@ -532,6 +534,61 @@ static void predict_row(const CBooster *b, const double *row,
     for (int k = 0; k < b->num_class; k++) out[k] = acc[k];
 }
 
+static int predict_threads(void) {
+    const char *env = getenv("LIGHTGBM_TPU_NUM_THREADS");
+    if (env) {
+        int v = atoi(env);
+        if (v >= 1) return v > 64 ? 64 : v;
+    }
+    long hw = sysconf(_SC_NPROCESSORS_ONLN);
+    int v = hw > 0 ? (int)hw : 1;
+    return v > 16 ? 16 : v;
+}
+
+typedef struct {
+    pthread_t tid;
+    const CBooster *b;
+    const void *data;
+    int data_type;
+    int32_t ncol;
+    int64_t r0, r1;
+    int t0, t1, use_iters, predict_type, w;
+    double *out;
+    int rc;
+} PredRange;
+
+static void *predict_range_thread(void *arg) {
+    PredRange *j = (PredRange *)arg;
+    const CBooster *b = j->b;
+    const int32_t ncol = j->ncol;
+    double *row = (double *)malloc(sizeof(double) * (size_t)ncol);
+    double *acc =
+        (double *)malloc(sizeof(double) * (size_t)b->num_class);
+    if (!row || !acc) {
+        free(row);
+        free(acc);
+        j->rc = 1;
+        return NULL;
+    }
+    for (int64_t r = j->r0; r < j->r1; r++) {
+        const double *rp;
+        if (j->data_type == C_API_DTYPE_FLOAT64) {
+            /* contiguous f64 input: walk it in place — no extra pass
+             * over the matrix on the hot serving path */
+            rp = ((const double *)j->data) + r * ncol;
+        } else {
+            const float *src = ((const float *)j->data) + r * ncol;
+            for (int c = 0; c < ncol; c++) row[c] = (double)src[c];
+            rp = row;
+        }
+        predict_row(b, rp, j->t0, j->t1, j->use_iters,
+                    j->predict_type, acc, j->out + (size_t)r * j->w);
+    }
+    free(row);
+    free(acc);
+    return NULL;
+}
+
 int LGBM_BoosterPredictForMat(void *handle, const void *data,
                               int data_type, int32_t nrow, int32_t ncol,
                               int is_row_major, int predict_type,
@@ -551,25 +608,48 @@ int LGBM_BoosterPredictForMat(void *handle, const void *data,
     int w = (predict_type == C_API_PREDICT_LEAF_INDEX) ? t1 - t0
                                                        : b->num_class;
 
-    double *row = (double *)malloc(sizeof(double) * (size_t)ncol);
-    double *acc = (double *)malloc(sizeof(double) * (size_t)b->num_class);
-    if (!row || !acc) { free(row); free(acc); return set_err("oom"); }
+    if (data_type != C_API_DTYPE_FLOAT32 &&
+        data_type != C_API_DTYPE_FLOAT64)
+        return set_err("data_type must be float32(0)/float64(1)");
 
-    for (int32_t r = 0; r < nrow; r++) {
-        if (data_type == C_API_DTYPE_FLOAT64) {
-            const double *src = ((const double *)data) + (size_t)r * ncol;
-            memcpy(row, src, sizeof(double) * (size_t)ncol);
-        } else if (data_type == C_API_DTYPE_FLOAT32) {
-            const float *src = ((const float *)data) + (size_t)r * ncol;
-            for (int c = 0; c < ncol; c++) row[c] = (double)src[c];
-        } else {
-            free(row); free(acc);
-            return set_err("data_type must be float32(0)/float64(1)");
-        }
-        predict_row(b, row, t0, t1, use_iters, predict_type, acc,
-                    out_result + (size_t)r * w);
+    /* rows are independent: split [0, nrow) across pthreads (the
+     * reference predictor's OpenMP batch loop, predictor.hpp:30);
+     * LIGHTGBM_TPU_NUM_THREADS overrides the hardware default */
+    int T = predict_threads();
+    if ((int64_t)nrow * (t1 - t0) < (int64_t)1 << 16) T = 1;
+    if (T > nrow) T = nrow > 0 ? nrow : 1;
+    PredRange *jobs =
+        (PredRange *)malloc(sizeof(PredRange) * (size_t)T);
+    if (!jobs) return set_err("oom");
+    int spawned = 0;
+    int oom = 0;
+    for (int t = 0; t < T; t++) {
+        jobs[t].b = b;
+        jobs[t].data = data;
+        jobs[t].data_type = data_type;
+        jobs[t].ncol = ncol;
+        jobs[t].r0 = (int64_t)nrow * t / T;
+        jobs[t].r1 = (int64_t)nrow * (t + 1) / T;
+        jobs[t].t0 = t0;
+        jobs[t].t1 = t1;
+        jobs[t].use_iters = use_iters;
+        jobs[t].predict_type = predict_type;
+        jobs[t].w = w;
+        jobs[t].out = out_result;
+        jobs[t].rc = 0;
     }
-    free(row); free(acc);
+    for (int t = 1; t < T; t++) {
+        if (pthread_create(&jobs[t].tid, NULL, predict_range_thread,
+                           &jobs[t]) != 0)
+            break;               /* unspawned ranges run on this thread */
+        spawned = t;
+    }
+    predict_range_thread(&jobs[0]);
+    for (int t = spawned + 1; t < T; t++) predict_range_thread(&jobs[t]);
+    for (int t = 1; t <= spawned; t++) pthread_join(jobs[t].tid, NULL);
+    for (int t = 0; t < T; t++) oom |= jobs[t].rc;
+    free(jobs);
+    if (oom) return set_err("oom");
     *out_len = (int64_t)nrow * w;
     return LGBM_API_OK;
 }

@@ -2,7 +2,7 @@
  * reference C ABI (include/LightGBM/c_api.h). Load a saved v4 text
  * model and predict from C with zero dependencies; train in Python.
  *
- * Build: gcc -O3 -shared -fPIC -o liblightgbm_tpu_capi.so capi.c -lm
+ * Build: gcc -O3 -shared -fPIC -pthread -o liblightgbm_tpu_capi.so capi.c -lm
  */
 #ifndef LIGHTGBM_TPU_CAPI_H_
 #define LIGHTGBM_TPU_CAPI_H_

@@ -25,12 +25,18 @@ def test_device_matches_host_paths(rng):
     np.testing.assert_allclose(outs, host, rtol=1e-5, atol=1e-6)
 
 
-def test_large_predict_uses_device_and_agrees(rng):
+def test_large_predict_uses_device_and_agrees(rng, monkeypatch):
     X = rng.normal(size=(9000, 6))
     y = X[:, 0] * 2 + np.sin(X[:, 1])
     ds = lgb.Dataset(X, label=y, free_raw_data=False)
     bst = lgb.train({"objective": "regression", "num_leaves": 31,
                      "verbosity": -1}, ds, 10)
+    # pin the DEVICE walk: on the CPU backend large batches otherwise
+    # route through the native C predictor (its parity is pinned in
+    # test_capi.py); this test owns the device-path coverage
+    from lightgbm_tpu import engine as E
+    monkeypatch.setattr(E.Booster, "_native_raw_scores",
+                        lambda *a, **k: None)
     pred_big = bst.predict(X)                  # device path (n*T large)
     pred_small = np.concatenate(
         [bst.predict(X[i:i + 100]) for i in range(0, 9000, 100)])
