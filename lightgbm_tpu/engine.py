@@ -41,6 +41,15 @@ class Booster:
         # bumped on every tree-set mutation; keys the packed-ensemble
         # prediction cache (stale packs otherwise survive rollback+retrain)
         self._model_version = 0
+        # native-predictor handle state, initialized EAGERLY: a lazy
+        # check-then-act would let two first-predict threads build
+        # different locks and then free a handle mid-walk
+        import threading as _threading
+        self._capi_lock = _threading.Lock()
+        self._capi_inflight = 0
+        self._capi_retired: List = []
+        self._capi_handle = None
+        self._capi_key = None
         self.best_score: Dict = {}
         self._valid_names: List[str] = []
         self._gbdt: Optional[GBDT] = None
@@ -289,12 +298,19 @@ class Booster:
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         """Batch prediction on raw features
         (gbdt_prediction.cpp / predictor.hpp analog)."""
-        X = self._as_matrix(data)
-        if X.shape[1] != self._max_feature_idx + 1 and not (
+        from .dataset import Dataset
+        # scipy sparse rides the native CSR predictor on the CPU
+        # backend without ever densifying; all other paths (and route
+        # fallbacks) materialize the dense matrix as before
+        sp = (data if hasattr(data, "tocsr")
+              and not isinstance(data, Dataset) else None)
+        X = self._as_matrix(data) if sp is None else None
+        ncol = (sp if sp is not None else X).shape[1]
+        if ncol != self._max_feature_idx + 1 and not (
                 kwargs.get("predict_disable_shape_check")
                 or self.params.get("predict_disable_shape_check")):
             raise ValueError(
-                f"The number of features in data ({X.shape[1]}) is not the "
+                f"The number of features in data ({ncol}) is not the "
                 f"same as it was in training data "
                 f"({self._max_feature_idx + 1}).\nYou can set "
                 "predict_disable_shape_check=true to discard this error")
@@ -308,9 +324,16 @@ class Booster:
         hi = min(len(trees), (start_iteration + num_iteration) * K)
         use = trees[lo:hi]
         if pred_leaf:
+            if X is None:
+                X = self._as_matrix(data)
+            nat = self._native_leaf_indices(X, use, lo, K)
+            if nat is not None:
+                return nat
             out = np.stack([t.predict_leaf_index(X) for t in use], axis=1)
             return out
         if pred_contrib:
+            if X is None:
+                X = self._as_matrix(data)
             # TreeSHAP (tree.h:141 PredictContrib): per-class
             # [n, n_features+1] blocks, last column = expected value
             nf = X.shape[1]
@@ -323,7 +346,13 @@ class Booster:
                 out /= len(use) // K
             return out
         es = self._early_stop_config(kwargs)
-        raw = self._predict_raw_scores(X, use, lo, K, early_stop=es)
+        raw = None
+        if sp is not None and es is None:
+            raw = self._native_raw_scores_csr(sp, use, lo, K)
+        if raw is None:
+            if X is None:
+                X = self._as_matrix(data)
+            raw = self._predict_raw_scores(X, use, lo, K, early_stop=es)
         if self._average_output and use:
             raw /= len(use) // K
         if K == 1:
@@ -331,6 +360,20 @@ class Booster:
         if raw_score:
             return raw
         return self._converted(raw)
+
+    def _native_route_lib(self, use, n, *, need_raw_sums=True):
+        """The capi library when the native predictor applies to this
+        call, else None (callers fall through to the device/host
+        paths): CPU backend, non-linear trees, enough work to amortize,
+        and — for score predictions — no in-walk RF averaging."""
+        import jax
+        if (not use or jax.default_backend() != "cpu"
+                or (need_raw_sums and self._average_output)
+                or any(t.is_linear for t in use)
+                or n * len(use) < (1 << 14)):
+            return None
+        from .native import capi_lib
+        return capi_lib()
 
     def _native_raw_scores(self, X, use, lo, K):
         """RAW [n, K] scores via the native C predictor (capi.c — the
@@ -342,29 +385,89 @@ class Booster:
         Python side applies objective transforms, so objective coverage
         never diverges. Handle cached per model version; invalidated by
         training/rollback like the packed device ensemble."""
-        import jax
         n = X.shape[0]
-        if (not use or jax.default_backend() != "cpu"
-                or self._average_output            # capi averages in-walk
-                or any(t.is_linear for t in use)
-                or n * len(use) < (1 << 14)):
+        lib = self._native_route_lib(use, n)
+        if lib is None:
             return None
-        from .native import capi_lib
-        lib = capi_lib()
+        return self._native_mat_call(X, use, lo, K, predict_type=1,
+                                     width=K, lib=lib)
+
+    def _native_leaf_indices(self, X, use, lo, K):
+        """pred_leaf via the native predictor: [n, len(use)] leaf ids in
+        one threaded pass instead of a host walk per tree. None when the
+        route does not apply."""
+        lib = self._native_route_lib(use, X.shape[0],
+                                     need_raw_sums=False)
+        if lib is None:
+            return None
+        out = self._native_mat_call(X, use, lo, K, predict_type=2,
+                                    width=len(use), lib=lib)
+        return None if out is None else out.astype(np.int32)
+
+    def _native_mat_call(self, X, use, lo, K, *, predict_type, width,
+                         lib):
+        """Shared dense call: [n, width] result of PredictForMat with
+        the iteration window mapped from predict's [lo:hi] slice (whole
+        iterations by contract). None on any native-side failure."""
+        import ctypes
+        n = X.shape[0]
+        Xc = np.ascontiguousarray(X, np.float64)
+        out = np.zeros(n * width, np.float64)
+        out_len = ctypes.c_int64()
+        rc = self._with_capi_handle(
+            lib, lambda h: lib.LGBM_BoosterPredictForMat(
+                h, Xc.ctypes.data_as(ctypes.c_void_p),
+                1, n, X.shape[1], 1, predict_type,
+                lo // K, len(use) // K, b"",
+                ctypes.byref(out_len), out))
+        if rc != 0 or out_len.value != n * width:
+            return None
+        return out.reshape(n, width)
+
+    def _native_raw_scores_csr(self, sp, use, lo, K):
+        """RAW [n, K] scores straight from a scipy CSR/CSC matrix via
+        LGBM_BoosterPredictForCSR — absent entries are 0.0 exactly like
+        the densify-then-predict path, but the dense matrix never
+        materializes. None when the route does not apply."""
+        n = sp.shape[0]
+        lib = self._native_route_lib(use, n)
         if lib is None:
             return None
         import ctypes
-        import threading
-        # handle lifecycle: ctypes calls release the GIL, so another
-        # thread may rebuild the cache mid-predict — never free a
-        # handle that could be in flight; retire it and free when the
-        # in-flight count drains (the reference's C API guards its
-        # predict path with a lock for the same reason, c_api.cpp
-        # SingleRowPredictor locks)
-        if not hasattr(self, "_capi_lock"):
-            self._capi_lock = threading.Lock()
-            self._capi_inflight = 0
-            self._capi_retired = []
+        csr = sp.tocsr()
+        if not csr.has_canonical_format:
+            # duplicate (row, col) entries: todense() SUMS them, while
+            # the C densify loop would keep the last — canonicalize a
+            # COPY so both paths agree without mutating caller data
+            csr = csr.copy()
+            csr.sum_duplicates()
+        indptr = np.ascontiguousarray(csr.indptr, np.int64)
+        indices = np.ascontiguousarray(csr.indices, np.int32)
+        data = np.ascontiguousarray(csr.data, np.float64)
+        out = np.zeros(n * K, np.float64)
+        out_len = ctypes.c_int64()
+        rc = self._with_capi_handle(lib, lambda h: lib.LGBM_BoosterPredictForCSR(
+            h, indptr.ctypes.data_as(ctypes.c_void_p), 3,
+            indices.ctypes.data_as(ctypes.c_void_p),
+            data.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(data)),
+            ctypes.c_int64(sp.shape[1]), 1,    # RAW
+            lo // K, len(use) // K, b"",
+            ctypes.byref(out_len), out))
+        if rc != 0 or out_len.value != n * K:
+            return None
+        return out.reshape(n, K)
+
+    def _with_capi_handle(self, lib, fn):
+        """Run ``fn(handle)`` against the cached native model handle.
+
+        Handle lifecycle: ctypes calls release the GIL, so another
+        thread may rebuild the cache mid-predict — never free a handle
+        that could be in flight; retire it and free when the in-flight
+        count drains (the reference's C API guards its predict path
+        with a lock for the same reason, c_api.cpp SingleRowPredictor).
+        Returns fn's result, or -1 when the handle cannot be built."""
+        import ctypes
         key = ("native", self._model_version)
         with self._capi_lock:
             if getattr(self, "_capi_key", None) != key:
@@ -383,7 +486,7 @@ class Booster:
                 finally:
                     os.unlink(path)
                 if rc != 0:
-                    return None
+                    return -1
                 old = getattr(self, "_capi_handle", None)
                 if old:
                     self._capi_retired.append(old)
@@ -396,18 +499,7 @@ class Booster:
             h = self._capi_handle
             self._capi_inflight += 1
         try:
-            # whole iterations by contract (predict slices [lo:hi] in
-            # iteration multiples); map to capi's iteration window
-            start_iteration = lo // K
-            num_iteration = len(use) // K
-            Xc = np.ascontiguousarray(X, np.float64)
-            out = np.zeros(n * K, np.float64)
-            out_len = ctypes.c_int64()
-            rc = lib.LGBM_BoosterPredictForMat(
-                h, Xc.ctypes.data_as(ctypes.c_void_p),
-                1, n, X.shape[1], 1, 1,        # f64, row-major, RAW
-                start_iteration, num_iteration, b"",
-                ctypes.byref(out_len), out)
+            return fn(h)
         finally:
             with self._capi_lock:
                 self._capi_inflight -= 1
@@ -415,9 +507,6 @@ class Booster:
                     for hr in self._capi_retired:
                         lib.LGBM_BoosterFree(hr)
                     self._capi_retired.clear()
-        if rc != 0 or out_len.value != n * K:
-            return None
-        return out.reshape(n, K)
 
     def __del__(self):
         try:

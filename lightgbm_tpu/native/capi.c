@@ -670,6 +670,58 @@ int LGBM_BoosterPredictForMatSingleRow(void *handle, const void *data,
                                      parameter, out_len, out_result);
 }
 
+typedef struct {
+    pthread_t tid;
+    const CBooster *b;
+    const void *indptr;
+    int indptr_type;
+    const int32_t *indices;
+    const void *data;
+    int data_type;
+    int64_t r0, r1;
+    int t0, t1, use_iters, predict_type, w;
+    double *out;
+    int rc;
+} CsrRange;
+
+static void *csr_range_thread(void *arg) {
+    CsrRange *j = (CsrRange *)arg;
+    const CBooster *b = j->b;
+    const int ncol = b->max_feature_idx + 1;
+    double *row = (double *)malloc(sizeof(double) * (size_t)ncol);
+    double *acc =
+        (double *)malloc(sizeof(double) * (size_t)b->num_class);
+    if (!row || !acc) {
+        free(row);
+        free(acc);
+        j->rc = 1;
+        return NULL;
+    }
+    for (int64_t r = j->r0; r < j->r1; r++) {
+        int64_t lo, hi;
+        if (j->indptr_type == C_API_DTYPE_INT32) {
+            lo = ((const int32_t *)j->indptr)[r];
+            hi = ((const int32_t *)j->indptr)[r + 1];
+        } else {
+            lo = ((const int64_t *)j->indptr)[r];
+            hi = ((const int64_t *)j->indptr)[r + 1];
+        }
+        for (int c = 0; c < ncol; c++) row[c] = 0.0;
+        for (int64_t i = lo; i < hi; i++) {
+            int32_t c = j->indices[i];
+            if (c >= ncol) continue;   /* feature unused by the model */
+            row[c] = (j->data_type == C_API_DTYPE_FLOAT64)
+                         ? ((const double *)j->data)[i]
+                         : (double)((const float *)j->data)[i];
+        }
+        predict_row(b, row, j->t0, j->t1, j->use_iters,
+                    j->predict_type, acc, j->out + (size_t)r * j->w);
+    }
+    free(row);
+    free(acc);
+    return NULL;
+}
+
 int LGBM_BoosterPredictForCSR(void *handle, const void *indptr,
                               int indptr_type, const int32_t *indices,
                               const void *data, int data_type,
@@ -694,47 +746,66 @@ int LGBM_BoosterPredictForCSR(void *handle, const void *indptr,
     if (tree_range(b, start_iteration, num_iteration, &t0, &t1,
                    &use_iters) != LGBM_API_OK)
         return LGBM_API_ERR;
+    if (indptr_type != C_API_DTYPE_INT32 &&
+        indptr_type != C_API_DTYPE_INT64)
+        return set_err("indptr_type must be int32(2)/int64(3)");
     int w = (predict_type == C_API_PREDICT_LEAF_INDEX) ? t1 - t0
                                                        : b->num_class;
-    int ncol = b->max_feature_idx + 1;
     int64_t nrow = nindptr - 1;
 
-    double *row = (double *)malloc(sizeof(double) * (size_t)ncol);
-    double *acc = (double *)malloc(sizeof(double) * (size_t)b->num_class);
-    if (!row || !acc) { free(row); free(acc); return set_err("oom"); }
-
+    /* validate all file/caller-derived extents BEFORE any walk (each
+     * worker below trusts them) */
     for (int64_t r = 0; r < nrow; r++) {
-        int64_t lo, hi;
-        if (indptr_type == C_API_DTYPE_INT32) {
-            lo = ((const int32_t *)indptr)[r];
-            hi = ((const int32_t *)indptr)[r + 1];
-        } else if (indptr_type == C_API_DTYPE_INT64) {
-            lo = ((const int64_t *)indptr)[r];
-            hi = ((const int64_t *)indptr)[r + 1];
-        } else {
-            free(row); free(acc);
-            return set_err("indptr_type must be int32(2)/int64(3)");
-        }
-        if (lo < 0 || hi < lo || hi > nelem) {
-            free(row); free(acc);
+        int64_t lo = (indptr_type == C_API_DTYPE_INT32)
+                         ? ((const int32_t *)indptr)[r]
+                         : ((const int64_t *)indptr)[r];
+        int64_t hi = (indptr_type == C_API_DTYPE_INT32)
+                         ? ((const int32_t *)indptr)[r + 1]
+                         : ((const int64_t *)indptr)[r + 1];
+        if (lo < 0 || hi < lo || hi > nelem)
             return set_err("indptr out of range");
-        }
-        for (int c = 0; c < ncol; c++) row[c] = 0.0;
-        for (int64_t i = lo; i < hi; i++) {
-            int32_t c = indices[i];
-            if (c < 0 || c >= num_col) {
-                free(row); free(acc);
-                return set_err("column index out of range");
-            }
-            if (c >= ncol) continue;   /* feature unused by the model */
-            row[c] = (data_type == C_API_DTYPE_FLOAT64)
-                         ? ((const double *)data)[i]
-                         : (double)((const float *)data)[i];
-        }
-        predict_row(b, row, t0, t1, use_iters, predict_type, acc,
-                    out_result + (size_t)r * w);
     }
-    free(row); free(acc);
+    for (int64_t i = 0; i < nelem; i++)
+        if (indices[i] < 0 || indices[i] >= num_col)
+            return set_err("column index out of range");
+
+    /* rows are independent: same pthread split as PredictForMat */
+    int T = predict_threads();
+    if (nrow * (t1 - t0) < (int64_t)1 << 16) T = 1;
+    if (T > nrow) T = nrow > 0 ? (int)nrow : 1;
+    CsrRange *jobs = (CsrRange *)malloc(sizeof(CsrRange) * (size_t)T);
+    if (!jobs) return set_err("oom");
+    int spawned = 0;
+    int oom = 0;
+    for (int t = 0; t < T; t++) {
+        jobs[t].b = b;
+        jobs[t].indptr = indptr;
+        jobs[t].indptr_type = indptr_type;
+        jobs[t].indices = indices;
+        jobs[t].data = data;
+        jobs[t].data_type = data_type;
+        jobs[t].r0 = nrow * t / T;
+        jobs[t].r1 = nrow * (t + 1) / T;
+        jobs[t].t0 = t0;
+        jobs[t].t1 = t1;
+        jobs[t].use_iters = use_iters;
+        jobs[t].predict_type = predict_type;
+        jobs[t].w = w;
+        jobs[t].out = out_result;
+        jobs[t].rc = 0;
+    }
+    for (int t = 1; t < T; t++) {
+        if (pthread_create(&jobs[t].tid, NULL, csr_range_thread,
+                           &jobs[t]) != 0)
+            break;
+        spawned = t;
+    }
+    csr_range_thread(&jobs[0]);
+    for (int t = spawned + 1; t < T; t++) csr_range_thread(&jobs[t]);
+    for (int t = 1; t <= spawned; t++) pthread_join(jobs[t].tid, NULL);
+    for (int t = 0; t < T; t++) oom |= jobs[t].rc;
+    free(jobs);
+    if (oom) return set_err("oom");
     *out_len = nrow * w;
     return LGBM_API_OK;
 }

@@ -108,7 +108,12 @@ def _walk(ens: PackedEnsemble, X: jax.Array) -> jax.Array:
         vz = jnp.where(nan & (mt != 2), 0.0, v)
         gl_num = vz <= thr
         defl = (dt & 2) != 0
-        gl_num = jnp.where(nan & (mt == 2), defl, gl_num)
+        # missing -> default side: NaN under MissingType::NaN, and
+        # |v| <= 1e-35 (incl. NaN folded to 0) under MissingType::Zero
+        # (tree.h:359; zeros must NOT take the threshold compare)
+        miss = ((nan & (mt == 2))
+                | ((jnp.abs(vz) <= 1e-35) & (mt == 1)))
+        gl_num = jnp.where(miss, defl, gl_num)
         # categorical: threshold holds the cat split index
         cat_idx = jnp.clip(thr.astype(jnp.int32), 0,
                            ens.cat_bound.shape[1] - 2)

@@ -480,7 +480,14 @@ class Tree:
             vn = np.where(nan & (mt != MISSING_NAN), 0.0, vn)
             gl = vn <= self.threshold[num]
             defl = (dt[num] & _DEFAULT_LEFT_BIT) != 0
-            out[:, num] = np.where(nan & (mt == MISSING_NAN), defl, gl)
+            # missing routes to the DEFAULT side: NaN under
+            # MissingType::NaN, and |v| <= kZeroThreshold (1e-35,
+            # incl. NaN folded to 0 above) under MissingType::Zero —
+            # tree.h:359 NumericalDecision (a zero must NOT fall
+            # through to the threshold compare)
+            miss = ((nan & (mt == MISSING_NAN))
+                    | ((np.abs(vn) <= 1e-35) & (mt == MISSING_ZERO)))
+            out[:, num] = np.where(miss, defl, gl)
         for j in np.nonzero(is_cat)[0]:
             cat_idx = int(self.threshold[j])
             lo = self.cat_boundaries[cat_idx]

@@ -466,3 +466,64 @@ def test_booster_predict_routes_through_native(capi, rng, tmp_path):
     p3 = b3.predict(X[:4096])
     assert p3.shape == (4096, 3)
     np.testing.assert_allclose(p3.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_booster_predict_native_leaf_and_csr_routes(capi, rng):
+    """pred_leaf and scipy-sparse inputs also ride the native predictor
+    on the CPU backend: leaf ids must equal the host per-tree walk, and
+    CSR predictions must equal densify-then-predict — without the dense
+    matrix ever materializing on the happy path."""
+    import scipy.sparse as sp_mod
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import engine as E
+    n, f = 20000, 10
+    mask = rng.rand(n, f) < 0.4
+    vals = rng.normal(size=(n, f)) * mask
+    y = (vals[:, 0] + vals[:, 1] > 0.2).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "zero_as_missing": True},
+                    lgb.Dataset(vals, label=y, free_raw_data=False), 8)
+
+    # leaf route vs host per-tree walk
+    leaves_native = bst.predict(vals, pred_leaf=True)
+    orig = E.Booster._native_leaf_indices
+    try:
+        E.Booster._native_leaf_indices = lambda *a, **k: None
+        leaves_host = bst.predict(vals, pred_leaf=True)
+    finally:
+        E.Booster._native_leaf_indices = orig
+    np.testing.assert_array_equal(leaves_native, leaves_host)
+
+    # CSR route vs densified
+    X = sp_mod.csr_matrix(vals)
+    p_csr = bst.predict(X)
+    p_dense = bst.predict(vals)
+    np.testing.assert_allclose(p_csr, p_dense, rtol=1e-12, atol=1e-15)
+    # raw + iteration window through CSR
+    r_csr = bst.predict(X, raw_score=True, num_iteration=4)
+    r_dense = bst.predict(vals, raw_score=True, num_iteration=4)
+    np.testing.assert_allclose(r_csr, r_dense, rtol=1e-12, atol=1e-15)
+
+
+def test_csr_route_canonicalizes_duplicates(capi, rng):
+    """A non-canonical CSR with duplicate (row, col) entries must
+    predict like todense() (which SUMS duplicates), not like a
+    last-wins densify."""
+    import scipy.sparse as sp_mod
+    import lightgbm_tpu as lgb
+    n, f = 17000, 6
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 4)
+    # duplicate column 0 entry in every row: 0.6 + 0.4 == X would sum,
+    # last-wins would see 0.4
+    indptr = np.arange(0, (n + 1) * 2, 2, dtype=np.int64)
+    indices = np.tile(np.array([0, 0], np.int32), n)
+    data = np.stack([X[:, 0] * 0.6, X[:, 0] * 0.4], 1).reshape(-1)
+    spm = sp_mod.csr_matrix((data, indices, indptr), shape=(n, f))
+    assert not spm.has_canonical_format
+    p_sp = bst.predict(spm)
+    p_dense = bst.predict(np.asarray(spm.todense()))
+    np.testing.assert_allclose(p_sp, p_dense, rtol=1e-12, atol=1e-12)

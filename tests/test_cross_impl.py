@@ -152,3 +152,39 @@ def test_reference_loads_our_reg_sqrt_model(rng, tmp_path):
                     lgb.Dataset(X, label=y, free_raw_data=False), 8)
     assert "regression sqrt" in bst.model_to_string()
     _roundtrip(bst, X, y, tmp_path, "regsqrt", atol=1e-7)
+
+
+def test_zero_as_missing_predictions_match_reference(rng, tmp_path):
+    """MissingType::Zero parity (round-5 regression): a zero value must
+    route to the DEFAULT side, not through the threshold compare
+    (tree.h:359). The host walk, the device ensemble walk, and the
+    native C predictor must all reproduce the reference binary exactly
+    on a zero-heavy zero_as_missing model."""
+    from lightgbm_tpu import engine as E
+    n, f = 3000, 8
+    mask = rng.rand(n, f) < 0.4
+    X = rng.normal(size=(n, f)) * mask
+    y = (X[:, 0] + X[:, 1] > 0.2).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "zero_as_missing": True},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 8)
+    model = str(tmp_path / "zam.txt")
+    data = str(tmp_path / "zam.data")
+    outp = str(tmp_path / "zam.pred")
+    bst.save_model(model)
+    np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.9g")
+    ref = _ref_predict(model, data, outp)
+
+    # native C route (big batch on CPU backend)
+    np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-6,
+                               atol=1e-9)
+    # host per-tree walk and device ensemble walk, each pinned
+    orig = E.Booster._native_raw_scores
+    try:
+        E.Booster._native_raw_scores = lambda *a, **k: None
+        np.testing.assert_allclose(bst.predict(X), ref, rtol=1e-6,
+                                   atol=1e-6)       # device f32 walk
+        np.testing.assert_allclose(bst.predict(X[:64]), ref[:64],
+                                   rtol=1e-6, atol=1e-9)  # host f64
+    finally:
+        E.Booster._native_raw_scores = orig
