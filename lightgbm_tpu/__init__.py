@@ -14,7 +14,7 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .dataset import Dataset, Sequence
-from .engine import Booster, CVBooster, cv, train
+from .engine import Booster, CVBooster, PredictSession, cv, train
 from .log import register_logger
 from .tree import Tree
 from . import plotting
@@ -30,7 +30,8 @@ except ImportError:  # pragma: no cover
 
 __version__ = "0.1.0"
 
-__all__ = ["Dataset", "Booster", "CVBooster", "train", "cv", "Config",
+__all__ = ["Dataset", "Booster", "CVBooster", "PredictSession", "train",
+           "cv", "Config",
            "BinMapper", "Tree", "Sequence", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
            "register_logger", "plotting", "plot_importance", "plot_metric",

@@ -70,6 +70,8 @@ from typing import NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+
+from ..native import jax_ffi as _jax_ffi
 import numpy as np
 
 from ..ops.histogram import (build_histograms, resolve_impl, HIST_CH,
@@ -391,7 +393,7 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             (S, mat.shape[1], nb_in, HIST_CH),
             jnp.int32 if q else jnp.float32)
         bf16 = bool((not q) and jnp.dtype(hist_dtype) == jnp.bfloat16)
-        h = jax.ffi.ffi_call(target, out_sds)(
+        h = _jax_ffi().ffi_call(target, out_sds)(
             mat, g, part[0], part[1], part[2], slots.astype(jnp.int32),
             bf16_round=bf16)
         if axis_name is not None:
@@ -1216,7 +1218,7 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 # metadata (feature-parallel pads the TRAIN matrix's
                 # feature axis; valid matrices stay unpadded)
                 F_mat = bmat.shape[1]
-                out = jax.ffi.ffi_call(
+                out = _jax_ffi().ffi_call(
                     "lgbtpu_relabel",
                     jax.ShapeDtypeStruct(rl.shape, jnp.int32))(
                     bmat, rl.astype(jnp.int32),
@@ -1270,7 +1272,7 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             # partition of each split leaf's segment; only those rows
             # are touched (and only they change row_leaf)
             mat_p = bins if bins_cm is None else bins_cm
-            outs = jax.ffi.ffi_call(
+            outs = _jax_ffi().ffi_call(
                 "lgbtpu_partition",
                 (jax.ShapeDtypeStruct((R,), jnp.int32),
                  jax.ShapeDtypeStruct((R,), jnp.int32),

@@ -26,7 +26,7 @@ from .metrics import create_metrics, Metric
 from .objectives import create_objective, Objective
 from .tree import Tree
 
-__all__ = ["Booster", "train", "cv", "CVBooster"]
+__all__ = ["Booster", "PredictSession", "train", "cv", "CVBooster"]
 
 
 class Booster:
@@ -353,6 +353,11 @@ class Booster:
             if X is None:
                 X = self._as_matrix(data)
             raw = self._predict_raw_scores(X, use, lo, K, early_stop=es)
+        return self._finalize_scores(raw, use, K, raw_score)
+
+    def _finalize_scores(self, raw, use, K, raw_score):
+        """RAW [n, K] -> user-facing predictions: RF averaging, class
+        squeeze, objective transform (shared with PredictSession)."""
         if self._average_output and use:
             raw /= len(use) // K
         if K == 1:
@@ -408,16 +413,24 @@ class Booster:
                          lib):
         """Shared dense call: [n, width] result of PredictForMat with
         the iteration window mapped from predict's [lo:hi] slice (whole
-        iterations by contract). None on any native-side failure."""
+        iterations by contract). None on any native-side failure.
+
+        Zero-copy handoff: C-contiguous float64 AND float32 matrices go
+        straight into the kernel (the C side widens f32 per value —
+        exact — inside its row blocks), so the serving path never
+        duplicates the feature matrix."""
         import ctypes
         n = X.shape[0]
-        Xc = np.ascontiguousarray(X, np.float64)
+        if X.dtype == np.float32 and X.flags.c_contiguous:
+            Xc, dtype_flag = X, 0
+        else:
+            Xc, dtype_flag = np.ascontiguousarray(X, np.float64), 1
         out = np.zeros(n * width, np.float64)
         out_len = ctypes.c_int64()
         rc = self._with_capi_handle(
             lib, lambda h: lib.LGBM_BoosterPredictForMat(
                 h, Xc.ctypes.data_as(ctypes.c_void_p),
-                1, n, X.shape[1], 1, predict_type,
+                dtype_flag, n, X.shape[1], 1, predict_type,
                 lo // K, len(use) // K, b"",
                 ctypes.byref(out_len), out))
         if rc != 0 or out_len.value != n * width:
@@ -653,6 +666,11 @@ class Booster:
                              for k in range(K)], axis=1)
 
         return run_chunked(plain_kernel, K)
+
+    def predict_session(self, **kwargs) -> "PredictSession":
+        """A persistent :class:`PredictSession` bound to this model —
+        the serving entry point for repeated predict() calls."""
+        return PredictSession(self, **kwargs)
 
     def _as_matrix(self, data) -> np.ndarray:
         if isinstance(data, Dataset):
@@ -1023,6 +1041,92 @@ class Booster:
     def __deepcopy__(self, memo):
         return Booster(model_str=self.model_to_string(),
                        params=dict(self.params))
+
+
+class PredictSession:
+    """Persistent prediction handle for the serving pattern: many
+    ``predict()`` calls against one (slowly-mutating) model.
+
+    What it caches, keyed by the Booster's model version:
+
+    - the resolved tree window (``start_iteration``/``num_iteration`` →
+      tree slice), computed once instead of per call;
+    - the packed device ensemble and its jit-compiled executable (the
+      Booster's ``(version, lo, hi)``-keyed pack plus XLA's trace
+      cache), so repeated device predictions never re-pack or re-trace;
+    - the native C model handle (via the Booster's version-keyed handle
+      cache), whose flattened node layout is built once at load.
+
+    Every cache invalidates when the model version moves (training,
+    rollback, leaf surgery, model reload) — the next ``predict()``
+    transparently rebuilds against the new trees.
+
+    On the CPU backend, C-contiguous float32/float64 matrices of the
+    training width hand off zero-copy into the native blocked kernel
+    (``capi.c``); everything else falls back to ``Booster.predict``
+    with identical results.
+    """
+
+    def __init__(self, booster: Booster, *, start_iteration: int = 0,
+                 num_iteration: Optional[int] = None,
+                 raw_score: bool = False, pred_leaf: bool = False,
+                 pred_contrib: bool = False, **kwargs):
+        self.booster = booster
+        self._start_iteration = start_iteration
+        self._num_iteration = num_iteration
+        self._raw_score = raw_score
+        self._pred_leaf = pred_leaf
+        self._pred_contrib = pred_contrib
+        self._extra = dict(kwargs)
+        self._version = None
+        self._refresh()
+
+    def _refresh(self):
+        """Re-resolve the tree window against the current model."""
+        b = self.booster
+        self._version = b._model_version
+        K = max(1, b._num_class)
+        trees = b._all_trees()
+        ni = self._num_iteration
+        if ni is None or ni < 0:
+            ni = (b.best_iteration if b.best_iteration > 0
+                  else len(trees) // K)
+        lo = self._start_iteration * K
+        hi = min(len(trees), (self._start_iteration + ni) * K)
+        self._K, self._lo = K, lo
+        self._use = trees[lo:hi]
+
+    def warmup(self, n_rows: int = 1024) -> "PredictSession":
+        """Build every lazy cache now (native handle / packed ensemble /
+        compiled executable) so the first real request pays nothing."""
+        X = np.zeros((n_rows, self.booster._max_feature_idx + 1),
+                     np.float32)
+        self.predict(X)
+        return self
+
+    def predict(self, data) -> np.ndarray:
+        b = self.booster
+        if b._model_version != self._version:
+            self._refresh()
+        fast = (not self._pred_leaf and not self._pred_contrib
+                and isinstance(data, np.ndarray) and data.ndim == 2
+                and data.dtype in (np.float32, np.float64)
+                and data.flags.c_contiguous
+                and data.shape[1] == b._max_feature_idx + 1
+                and b._early_stop_config(self._extra) is None)
+        if fast:
+            raw = b._native_raw_scores(data, self._use, self._lo,
+                                       self._K)
+            if raw is not None:
+                return b._finalize_scores(raw, self._use, self._K,
+                                          self._raw_score)
+        return b.predict(data, start_iteration=self._start_iteration,
+                         num_iteration=self._num_iteration,
+                         raw_score=self._raw_score,
+                         pred_leaf=self._pred_leaf,
+                         pred_contrib=self._pred_contrib, **self._extra)
+
+    __call__ = predict
 
 
 def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,

@@ -20,8 +20,20 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["native_lib", "capi_lib", "hist_lib",
+__all__ = ["native_lib", "capi_lib", "hist_lib", "jax_ffi",
            "parse_delimited", "parse_libsvm"]
+
+
+def jax_ffi():
+    """The jax FFI namespace across versions: ``jax.ffi`` where it
+    exists (0.5+), else ``jax.extend.ffi`` (0.4.x) — same surface
+    (include_dir / pycapsule / register_ffi_target / ffi_call)."""
+    import jax
+    ffi = getattr(jax, "ffi", None)
+    if ffi is not None:
+        return ffi
+    import jax.extend as jex
+    return jex.ffi
 
 _LIB = None
 _TRIED = False
@@ -143,7 +155,8 @@ def capi_lib():
             ctypes.POINTER(ctypes.c_int64), _DOUBLE_P]
         for g in ("LGBM_BoosterGetCurrentIteration",
                   "LGBM_BoosterNumModelPerIteration",
-                  "LGBM_BoosterNumberOfTotalModel"):
+                  "LGBM_BoosterNumberOfTotalModel",
+                  "LGBM_BoosterGetPredictLayout"):
             fn = getattr(lib, g)
             fn.restype = ctypes.c_int
             fn.argtypes = [ctypes.c_void_p,
@@ -174,30 +187,30 @@ def hist_lib():
     if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
         return None
     try:
-        import jax
-        inc = jax.ffi.include_dir()
+        ffi = jax_ffi()
+        inc = ffi.include_dir()
         lib = _compile_and_load(
             "hist_ffi.cc", "lightgbm_tpu_hist_ffi",
             extra_gcc=("-std=c++17", "-pthread", f"-I{inc}"),
             compiler="g++")
-        jax.ffi.register_ffi_target(
-            "lgbtpu_hist_f32", jax.ffi.pycapsule(lib.LgbtpuHistF32),
+        ffi.register_ffi_target(
+            "lgbtpu_hist_f32", ffi.pycapsule(lib.LgbtpuHistF32),
             platform="cpu")
-        jax.ffi.register_ffi_target(
-            "lgbtpu_hist_i8", jax.ffi.pycapsule(lib.LgbtpuHistI8),
+        ffi.register_ffi_target(
+            "lgbtpu_hist_i8", ffi.pycapsule(lib.LgbtpuHistI8),
             platform="cpu")
-        jax.ffi.register_ffi_target(
-            "lgbtpu_relabel", jax.ffi.pycapsule(lib.LgbtpuRelabel),
+        ffi.register_ffi_target(
+            "lgbtpu_relabel", ffi.pycapsule(lib.LgbtpuRelabel),
             platform="cpu")
-        jax.ffi.register_ffi_target(
-            "lgbtpu_partition", jax.ffi.pycapsule(lib.LgbtpuPartition),
+        ffi.register_ffi_target(
+            "lgbtpu_partition", ffi.pycapsule(lib.LgbtpuPartition),
             platform="cpu")
-        jax.ffi.register_ffi_target(
+        ffi.register_ffi_target(
             "lgbtpu_hist_perm_f32",
-            jax.ffi.pycapsule(lib.LgbtpuHistPermF32), platform="cpu")
-        jax.ffi.register_ffi_target(
+            ffi.pycapsule(lib.LgbtpuHistPermF32), platform="cpu")
+        ffi.register_ffi_target(
             "lgbtpu_hist_perm_i8",
-            jax.ffi.pycapsule(lib.LgbtpuHistPermI8), platform="cpu")
+            ffi.pycapsule(lib.LgbtpuHistPermI8), platform="cpu")
         _HIST = lib
     except Exception:
         _HIST = None

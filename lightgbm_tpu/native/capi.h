@@ -74,6 +74,13 @@ int LGBM_BoosterGetCurrentIteration(void *handle, int *out_iteration);
 int LGBM_BoosterNumModelPerIteration(void *handle, int *out_tpi);
 int LGBM_BoosterNumberOfTotalModel(void *handle, int *out_models);
 
+/* Prediction engine introspection (this implementation only): writes 1
+ * when predictions will run on the flattened cache-blocked node layout
+ * built at model load, 0 when the legacy per-tree walker serves them
+ * (layout build failed, or LIGHTGBM_TPU_PREDICT_LEGACY=1 pins the
+ * legacy path). Both walkers are bit-identical by contract. */
+int LGBM_BoosterGetPredictLayout(void *handle, int *out_blocked);
+
 #ifdef __cplusplus
 }
 #endif

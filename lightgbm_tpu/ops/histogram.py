@@ -35,6 +35,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from ..native import jax_ffi as _jax_ffi
 import numpy as np
 
 __all__ = ["build_histograms", "resolve_impl", "HIST_CH"]
@@ -66,7 +68,10 @@ def _pvary(x, axis_name):
     pcast = getattr(jax.lax, "pcast", None)
     if pcast is not None:
         return pcast(x, axis_name, to="varying")
-    return jax.lax.pvary(x, axis_name)  # older jax
+    pvary = getattr(jax.lax, "pvary", None)
+    if pvary is not None:
+        return pvary(x, axis_name)
+    return x  # 0.4.x shard_map: no varying-mark concept — no-op
 
 
 # Pallas training-path survivability: the fused kernel has never met a
@@ -282,7 +287,7 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
             nr_in = jnp.asarray(nr_in, jnp.int32).reshape((1,))
             out_sds = jax.ShapeDtypeStruct((L, F, B, HIST_CH), acc_dt_n)
             target = "lgbtpu_hist_i8" if quant else "lgbtpu_hist_f32"
-            hist = jax.ffi.ffi_call(target, out_sds)(
+            hist = _jax_ffi().ffi_call(target, out_sds)(
                 bins, gh, row_leaf.astype(jnp.int32),
                 leaf_ids.astype(jnp.int32), rg_in, nr_in,
                 bf16_round=bf16_round, use_gather=has_rg)

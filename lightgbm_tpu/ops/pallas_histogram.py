@@ -169,6 +169,14 @@ def _plan_chunks(F: int, B: int, L: int, vmem_budget: int = 10 << 20):
     return blk, fc, Bp, l_pad
 
 
+def _compiler_params(**kw):
+    """pltpu.CompilerParams across jax versions (TPUCompilerParams
+    before the rename)."""
+    cls = getattr(pltpu, "CompilerParams", None) or \
+        getattr(pltpu, "TPUCompilerParams")
+    return cls(**kw)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("num_bins", "hist_dtype", "interpret"))
@@ -245,7 +253,7 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
                                            acc_dt),
             # feature chunks are independent; the row dim revisits the
             # same accumulator block and must stay sequential
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interpret,
         )(bins.astype(jnp.int32), gh8, leaf8, lids8)
@@ -279,7 +287,7 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
             ),
             out_shape=jax.ShapeDtypeStruct((n_fb * fb_pad, lb3_pad),
                                            acc_dt),
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_compiler_params(
                 dimension_semantics=("parallel", "arbitrary")),
             interpret=interpret,
         )(nr, bins.astype(jnp.int32), gh8, leaf8, lids8)

@@ -33,6 +33,25 @@ class PackedEnsemble(NamedTuple):
     cat_bound: jax.Array       # [T, C+1] int32 cat split word bounds
     cat_words: jax.Array       # [T, W] int32 bitset words
     num_leaves: jax.Array      # [T] int32
+    depth: jax.Array           # [T] int32 max root-to-leaf depth
+
+
+def _tree_depth(t) -> int:
+    """Max root-to-leaf edge count (children always follow their parent
+    in this writer's numbering, so one forward pass suffices)."""
+    ni = t.num_leaves - 1
+    if ni <= 0:
+        return 0
+    nd = np.zeros(ni, np.int64)
+    mx = 1
+    for n in range(ni):
+        d = int(nd[n]) + 1
+        for c in (int(t.left_child[n]), int(t.right_child[n])):
+            if c >= 0:
+                nd[c] = max(int(nd[c]), d)
+            elif d > mx:
+                mx = d
+    return max(mx, int(nd.max()) + 1 if ni > 0 else 1)
 
 
 def pack_ensemble(trees: List) -> PackedEnsemble:
@@ -52,9 +71,11 @@ def pack_ensemble(trees: List) -> PackedEnsemble:
     cb = np.zeros((T, C + 1), np.int32)
     cw = np.zeros((T, W), np.int64)
     nl = np.zeros(T, np.int32)
+    dep = np.zeros(T, np.int32)
     for i, t in enumerate(trees):
         ni = t.num_leaves - 1
         nl[i] = t.num_leaves
+        dep[i] = _tree_depth(t)
         lv[i, :t.num_leaves] = t.leaf_value
         if ni <= 0:
             continue
@@ -67,7 +88,8 @@ def pack_ensemble(trees: List) -> PackedEnsemble:
         if t.cat_threshold:
             cw[i, :len(t.cat_threshold)] = t.cat_threshold
     return PackedEnsemble(*map(jnp.asarray,
-                               (sf, thr, dt, lc, rc, lv, cb, cw, nl)))
+                               (sf, thr, dt, lc, rc, lv, cb, cw, nl,
+                                dep)))
 
 
 def _walk(ens: PackedEnsemble, X: jax.Array) -> jax.Array:
@@ -83,13 +105,18 @@ def _walk(ens: PackedEnsemble, X: jax.Array) -> jax.Array:
     node = jnp.zeros((n, T), jnp.int32)     # >=0 internal; <0 => ~leaf
     single = (ens.num_leaves <= 1)[None, :]  # stump trees: leaf 0
     node = jnp.where(single, -1, node)       # ~0
+    # depth clamp: the loop early-exits when every (row, tree) lane hits
+    # a leaf, and is HARD-bounded by the ensemble's max root-to-leaf
+    # depth computed at pack time — a corrupted pack (cycle) can stall
+    # lanes but never hang the device walk
+    dmax = jnp.max(ens.depth)
 
     def cond(state):
-        node, active = state
-        return jnp.any(active)
+        node, active, it = state
+        return jnp.any(active) & (it < dmax)
 
     def body(state):
-        node, active = state
+        node, active, it = state
         nodec = jnp.clip(node, 0, ens.split_feature.shape[1] - 1)
 
         def take2(a):
@@ -133,9 +160,10 @@ def _walk(ens: PackedEnsemble, X: jax.Array) -> jax.Array:
         nxt = jnp.where(go_left, take2(ens.left_child),
                         take2(ens.right_child))
         node = jnp.where(active, nxt, node)
-        return node, node >= 0
+        return node, node >= 0, it + 1
 
-    node, _ = jax.lax.while_loop(cond, body, (node, node >= 0))
+    node, _, _ = jax.lax.while_loop(
+        cond, body, (node, node >= 0, jnp.asarray(0, jnp.int32)))
     leaf = jnp.clip(~node, 0, ens.leaf_value.shape[1] - 1)
     out = jax.vmap(lambda col, at: jnp.take(at, col),
                    in_axes=(1, 0), out_axes=1)(leaf, ens.leaf_value)
@@ -176,7 +204,7 @@ def predict_raw_device_early_stop(ens: PackedEnsemble, X: jax.Array,
             padt(ens.decision_type), padt(ens.left_child, -1),
             padt(ens.right_child, -1), padt(ens.leaf_value),
             padt(ens.cat_bound), padt(ens.cat_words),
-            padt(ens.num_leaves, 1))
+            padt(ens.num_leaves, 1), padt(ens.depth))
     # tree i of every chunk belongs to class i % K (trees are stored
     # iteration-major, and chunks hold whole iterations)
     cls_oh = (jnp.arange(chunk, dtype=jnp.int32)[:, None] % K

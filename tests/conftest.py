@@ -86,10 +86,13 @@ def run_isolated(test_file, name, timeout=900):
     # a CI-level PYTEST_ADDOPTS (e.g. --collect-only) must not rewrite
     # the child invocation into a no-op that exits 0
     env.pop("PYTEST_ADDOPTS", None)
-    # -n 0 overrides the pyproject addopts' xdist distribution: the
-    # child runs exactly one test and must execute it inline
-    cmd = [sys.executable, "-m", "pytest", "-q", "-x", "-n", "0", "-p",
+    cmd = [sys.executable, "-m", "pytest", "-q", "-x", "-p",
            "no:cacheprovider", os.path.abspath(test_file) + "::" + name]
+    try:  # if xdist is active in the parent, pin the child inline
+        import xdist  # noqa: F401
+        cmd[4:4] = ["-n", "0"]
+    except ImportError:
+        pass
     try:
         r = subprocess.run(cmd, capture_output=True, text=True,
                            timeout=timeout, env=env)

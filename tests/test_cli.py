@@ -10,6 +10,12 @@ import pytest
 from lightgbm_tpu.cli import run, _parse_argv
 
 EX = "/root/reference/examples"
+# example-conf tests need the reference checkout; hosts without it
+# (fresh containers) must skip, not fail (same contract as
+# test_cross_impl's .ref_build guard)
+needs_examples = pytest.mark.skipif(
+    not os.path.isdir(EX),
+    reason="reference examples not available (/root/reference)")
 ENV = dict(os.environ, JAX_PLATFORMS="cpu",
            PYTHONPATH=os.path.dirname(os.path.dirname(
                os.path.abspath(__file__))))
@@ -29,6 +35,7 @@ def test_parse_argv_precedence(tmp_path):
     assert p["num_trees"] == "7"
 
 
+@needs_examples
 def test_cli_train_then_predict(tmp_path):
     r = _cli([f"config={EX}/binary_classification/train.conf",
               "num_trees=5", "num_leaves=15", "verbosity=-1"],
@@ -44,6 +51,7 @@ def test_cli_train_then_predict(tmp_path):
     assert np.isfinite(pred).all() and (0 <= pred).all() and (pred <= 1).all()
 
 
+@needs_examples
 def test_cli_save_binary(tmp_path):
     r = _cli(["task=save_binary",
               f"data={EX}/binary_classification/binary.train"],
@@ -119,6 +127,7 @@ def test_convert_model_c_code_matches_predictions(tmp_path, rng):
     np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
 
 
+@needs_examples
 def test_parallel_learning_example_conf(tmp_path):
     """The reference's shipped examples/parallel_learning/train.conf
     (tree_learner=feature) runs unmodified via our CLI on the virtual

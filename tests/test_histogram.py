@@ -62,7 +62,8 @@ def test_psum_merge_across_shards(rng):
     """Data-parallel histogram merge == single-device histogram
     (ReduceScatter semantics, data_parallel_tree_learner.cpp:284)."""
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    from lightgbm_tpu.parallel.data_parallel import _shard_map as \
+        shard_map  # version shim: jax.shard_map past 0.4.x
 
     n_dev = len(jax.devices())
     assert n_dev == 8, "conftest should force 8 cpu devices"
@@ -492,7 +493,7 @@ def test_native_perm_kernel_threaded_matches_serial(rng, monkeypatch):
         out_dt = jnp.int32 if gh.dtype == np.int8 else jnp.float32
         target = ("lgbtpu_hist_perm_i8" if gh.dtype == np.int8
                   else "lgbtpu_hist_perm_f32")
-        return np.asarray(jax.ffi.ffi_call(
+        return np.asarray(N.jax_ffi().ffi_call(
             target, jax.ShapeDtypeStruct((S, F, B, 3), out_dt))(
             jnp.asarray(bins), jnp.asarray(gh), jnp.asarray(perm),
             jnp.asarray(begin), jnp.asarray(cnt), jnp.asarray(lids),

@@ -1,0 +1,194 @@
+"""Serving-grade prediction engine: cross-path parity of the native
+blocked walker (capi.c FlatModel), the native legacy walker, the device
+lock-step walk and the host per-tree walk, plus PredictSession cache
+semantics (ISSUE 1 tentpole)."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import engine as E
+from lightgbm_tpu.native import capi_lib
+
+
+@pytest.fixture(scope="module")
+def capi():
+    lib = capi_lib()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return lib
+
+
+def _serving_model(rng, n=24000, f=8):
+    """Categorical + zero-as-missing model over f32-exact features
+    (multiples of 1/8), so every path — including the f32 device walk —
+    sees bit-identical inputs and thresholds (bin bounds are midpoints:
+    multiples of 1/16, exact in both widths)."""
+    X = (rng.randint(-16, 17, size=(n, f)) / 8.0)
+    X[:, 2] = rng.randint(0, 12, size=n)              # categorical
+    X[rng.rand(n, f) < 0.25] = 0.0                    # zeros == missing
+    y = (X[:, 0] + np.where(np.isin(X[:, 2], [1, 3, 7]), 1.0, -0.5)
+         + 0.25 * X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "zero_as_missing": True,
+                     "categorical_feature": [2]},
+                    lgb.Dataset(X, label=y, free_raw_data=False,
+                                categorical_feature=[2]), 10)
+    return X, bst
+
+
+def test_cross_path_predict_parity(capi, rng, monkeypatch):
+    """native-blocked == native-legacy bit-for-bit (the acceptance
+    contract of the flattened layout), and both match the device and
+    host walks on an f32-exact categorical + zero-as-missing model."""
+    X, bst = _serving_model(rng)
+    n = len(X)
+
+    p_blocked = bst.predict(X, raw_score=True)
+    assert bst._capi_key is not None, "native route did not engage"
+
+    monkeypatch.setenv("LIGHTGBM_TPU_PREDICT_LEGACY", "1")
+    p_legacy = bst.predict(X, raw_score=True)
+    monkeypatch.delenv("LIGHTGBM_TPU_PREDICT_LEGACY")
+    np.testing.assert_array_equal(p_blocked, p_legacy)
+
+    # device lock-step walk (f32 features exact on this data; leaf sums
+    # accumulate per-class in f64 on host, leaf values are f32-rounded)
+    monkeypatch.setattr(E.Booster, "_native_raw_scores",
+                        lambda *a, **k: None)
+    p_device = bst.predict(X, raw_score=True)
+    np.testing.assert_allclose(p_device, p_blocked, rtol=1e-5, atol=1e-6)
+
+    # host per-tree walk: batches small enough to duck the device
+    # cutover (n * trees < 2^16); f64 end to end like the native walk
+    p_host = np.concatenate([bst.predict(X[i:i + 4096], raw_score=True)
+                             for i in range(0, n, 4096)])
+    np.testing.assert_allclose(p_host, p_blocked, rtol=1e-12, atol=1e-13)
+
+
+def test_blocked_vs_legacy_leaf_csr_multiclass(capi, rng, monkeypatch):
+    """The blocked kernel serves every predict type: leaf indices and
+    the CSR route must be bit-identical to the legacy walker; multiclass
+    softmax goes through the same per-row transform."""
+    import scipy.sparse as sp
+    X, bst = _serving_model(rng)
+
+    leaves_b = bst.predict(X, pred_leaf=True)
+    spm = sp.csr_matrix(X)
+    csr_b = bst.predict(spm, raw_score=True)
+    monkeypatch.setenv("LIGHTGBM_TPU_PREDICT_LEGACY", "1")
+    leaves_l = bst.predict(X, pred_leaf=True)
+    csr_l = bst.predict(spm, raw_score=True)
+    monkeypatch.delenv("LIGHTGBM_TPU_PREDICT_LEGACY")
+    np.testing.assert_array_equal(leaves_b, leaves_l)
+    np.testing.assert_array_equal(csr_b, csr_l)
+
+    n = len(X)
+    y3 = rng.randint(0, 3, size=n).astype(float)
+    b3 = lgb.train({"objective": "multiclass", "num_class": 3,
+                    "num_leaves": 15, "verbosity": -1},
+                   lgb.Dataset(X, label=y3, free_raw_data=False), 5)
+    p3_b = b3.predict(X)
+    monkeypatch.setenv("LIGHTGBM_TPU_PREDICT_LEGACY", "1")
+    p3_l = b3.predict(X)
+    monkeypatch.delenv("LIGHTGBM_TPU_PREDICT_LEGACY")
+    np.testing.assert_array_equal(p3_b, p3_l)
+    np.testing.assert_allclose(p3_b.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_predict_layout_reports_blocked(capi, rng, tmp_path,
+                                        monkeypatch):
+    """LGBM_BoosterGetPredictLayout: 1 when the flattened layout serves
+    predictions, 0 when the legacy env pin is set."""
+    X, bst = _serving_model(rng, n=2000)
+    path = tmp_path / "m.txt"
+    bst.save_model(str(path))
+    handle = ctypes.c_void_p()
+    iters = ctypes.c_int()
+    rc = capi.LGBM_BoosterCreateFromModelfile(
+        str(path).encode(), ctypes.byref(iters), ctypes.byref(handle))
+    assert rc == 0, capi.LGBM_GetLastError()
+    layout = ctypes.c_int()
+    assert capi.LGBM_BoosterGetPredictLayout(
+        handle, ctypes.byref(layout)) == 0
+    assert layout.value == 1
+    monkeypatch.setenv("LIGHTGBM_TPU_PREDICT_LEGACY", "1")
+    capi.LGBM_BoosterGetPredictLayout(handle, ctypes.byref(layout))
+    assert layout.value == 0
+    monkeypatch.delenv("LIGHTGBM_TPU_PREDICT_LEGACY")
+    capi.LGBM_BoosterFree(handle)
+
+
+def test_predict_session_cache_invalidation(capi, rng):
+    """The serving contract: a PredictSession keeps serving across
+    model mutations — version-keyed caches (tree window, packed
+    ensemble, native handle) rebuild on the first predict after the
+    model changes, and results always match a fresh Booster.predict."""
+    X, bst = _serving_model(rng)
+    Xf = np.ascontiguousarray(X, np.float32)
+
+    sess = bst.predict_session(raw_score=True)
+    p1 = sess.predict(Xf)
+    v1, key1 = sess._version, bst._capi_key
+    assert key1 is not None
+    np.testing.assert_array_equal(p1, sess.predict(Xf))  # stable cache
+    assert bst._capi_key == key1                         # no churn
+
+    bst.update()                                         # model moves
+    p2 = sess.predict(Xf)
+    assert sess._version != v1, "session did not observe the new model"
+    assert bst._capi_key != key1, "native handle was not rebuilt"
+    assert not np.allclose(p1, p2)
+    fresh = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(
+        p2, fresh.predict(np.asarray(Xf, np.float64), raw_score=True),
+        rtol=1e-12, atol=1e-13)
+
+    # rollback invalidates too
+    bst.rollback_one_iter()
+    p3 = sess.predict(Xf)
+    np.testing.assert_array_equal(p1, p3)
+
+
+def test_session_zero_copy_f32_handoff(capi, rng):
+    """A C-contiguous float32 matrix rides into the native kernel
+    without any host-side copy or cast and yields the same predictions
+    as the float64 path (f32->f64 widening is exact; features here are
+    f32-exact so routing cannot differ)."""
+    X, bst = _serving_model(rng)
+    Xf = np.ascontiguousarray(X, np.float32)
+    sess = bst.predict_session()
+    p32 = sess.predict(Xf)
+    p64 = bst.predict(X)
+    np.testing.assert_array_equal(p32, p64)
+    # non-contiguous input still works (copies, same numbers)
+    p_stride = sess.predict(np.asfortranarray(Xf))
+    np.testing.assert_array_equal(p_stride, p64)
+
+
+def test_packed_ensemble_depth_clamp(rng):
+    """pack_ensemble's per-tree depth bounds the device walk: the
+    clamp must never truncate a legitimate walk (parity with the host
+    paths proves it), and the recorded depths must cover the deepest
+    leaf of each tree."""
+    from lightgbm_tpu.ops.predict_ensemble import pack_ensemble
+    X = rng.normal(size=(4000, 6))
+    y = X[:, 0] * 2 + np.sin(3 * X[:, 1])
+    bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), 8)
+    trees = bst._gbdt.models
+    ens = pack_ensemble(trees)
+    depths = np.asarray(ens.depth)
+    assert depths.shape == (len(trees),)
+    for t, d in zip(trees, depths):
+        # a 63-leaf tree needs depth in [log2(63), 62]
+        assert 6 <= d <= t.num_leaves - 1
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.predict_ensemble import predict_raw_device
+    outs = np.asarray(predict_raw_device(ens,
+                                         jnp.asarray(X, jnp.float32)))
+    host = np.stack([t.predict(X) for t in trees], axis=1)
+    np.testing.assert_allclose(outs, host, rtol=1e-5, atol=1e-6)
