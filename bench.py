@@ -23,7 +23,12 @@ always-cold `binning_cold_s`, `hist_native_threads_ablation` and
 `predict_threads_ablation` sweeps, session-based `predict_rows_per_s`,
 and the same-host reference predict probe
 (`ref_same_host_predict_rows_per_s`, wall-clock — task=predict has no
-internal timer).
+internal timer). ISSUE 2 adds the serving probes (`serve_bench`):
+HTTP rows/s + p99 through the micro-batched prediction server at
+1/8/64 concurrent clients, the batching speedup over single-client
+sequential, mean coalesced batch size, and a mid-burst hot-swap probe
+(zero failed requests, zero mixed-version results). BENCH_SERVE=0
+skips; BENCH_SERVE_ROWS sets rows per request (default 16).
 """
 
 import json
@@ -394,6 +399,152 @@ def kernel_roofline_fields(platform: str, t_hist_s: float,
 HIST_CH_BENCH = 3
 
 
+def serve_bench(bst, Xv) -> dict:
+    """Serving probes (ISSUE 2): end-to-end HTTP throughput + p99 at
+    1/8/64 concurrent clients against the micro-batched prediction
+    server, plus a mid-burst hot-swap probe. BENCH_SERVE=0 skips.
+
+    The acceptance numbers: `serve_rows_per_s` (the 8-client figure)
+    must reach >= 3x `serve_rows_per_s_c1` (single-client sequential —
+    coalescing actually amortizes the per-request fixed cost),
+    `serve_mean_batch_rows` > 1, and the swap probe must complete with
+    zero failed requests and zero mixed-version results."""
+    import http.client
+    import tempfile
+    import threading
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serving import PredictionServer
+
+    rows_per_req = int(os.environ.get("BENCH_SERVE_ROWS", 16))
+    Xq = np.ascontiguousarray(Xv[:rows_per_req], np.float64)
+    buf = __import__("io").BytesIO()
+    np.save(buf, Xq)
+    body = buf.getvalue()
+    fields = {"serve_rows_per_req": rows_per_req}
+
+    with tempfile.TemporaryDirectory(prefix="bench_serve_") as td:
+        full = os.path.join(td, "full.txt")
+        half = os.path.join(td, "half.txt")
+        bst.save_model(full)
+        bst.save_model(half,
+                       num_iteration=max(1, bst.current_iteration() // 2))
+
+        srv = PredictionServer(port=0, max_batch_rows=1024,
+                               max_wait_us=2000)
+        srv.registry.register("default", full)
+        port = srv.start()
+
+        def burst(clients: int, reqs_each: int, on_resp=None):
+            """reqs_each sequential requests from each of `clients`
+            keep-alive connections; returns (rows/s, p99_ms, errors)."""
+            lat, errors = [], []
+            lock = threading.Lock()
+
+            def client():
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=60)
+                try:
+                    for _ in range(reqs_each):
+                        t0 = time.time()
+                        conn.request(
+                            "POST", "/predict", body=body,
+                            headers={"Content-Type":
+                                     "application/x-npy"})
+                        r = conn.getresponse()
+                        data = r.read()
+                        dt = time.time() - t0
+                        if r.status != 200:
+                            raise RuntimeError(
+                                f"status {r.status}: {data[:200]}")
+                        with lock:
+                            lat.append(dt)
+                        if on_resp is not None:
+                            on_resp(data)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                finally:
+                    conn.close()
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(clients)]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.time() - t0
+            done = len(lat)
+            rps = done * rows_per_req / wall if wall > 0 else 0.0
+            p99 = (float(np.percentile(lat, 99)) * 1e3 if lat else 0.0)
+            return rps, p99, errors
+
+        burst(2, 3)   # warm the HTTP path + every ladder bucket in play
+        for clients in (1, 8, 64):
+            reqs_each = max(8, 256 // clients)
+            rps, p99, errors = burst(clients, reqs_each)
+            fields[f"serve_rows_per_s_c{clients}"] = round(rps, 1)
+            fields[f"serve_p99_ms_c{clients}"] = round(p99, 2)
+            if errors:
+                fields[f"serve_errors_c{clients}"] = errors[:3]
+            print(f"serve: {clients} clients x {reqs_each} reqs -> "
+                  f"{rps:.0f} rows/s, p99 {p99:.1f} ms", file=sys.stderr)
+        fields["serve_rows_per_s"] = fields["serve_rows_per_s_c8"]
+        fields["serve_p99_ms"] = fields["serve_p99_ms_c8"]
+        c1 = fields["serve_rows_per_s_c1"]
+        fields["serve_batching_speedup"] = round(
+            fields["serve_rows_per_s"] / c1, 2) if c1 else 0.0
+
+        # mid-burst hot-swap probe: every in-burst result must match one
+        # WHOLE version (the truncated-ensemble v2 differs from v1 far
+        # beyond cross-path predict tolerance), with zero failures
+        exp1 = lgb.Booster(model_file=full).predict(Xq)
+        exp2 = lgb.Booster(model_file=half).predict(Xq)
+        mixed = [0]
+        mlock = threading.Lock()
+
+        def check(data):
+            got = np.load(__import__("io").BytesIO(data))
+            if not (np.allclose(got, exp1, rtol=1e-6, atol=1e-9)
+                    or np.allclose(got, exp2, rtol=1e-6, atol=1e-9)):
+                with mlock:
+                    mixed[0] += 1
+
+        swap_err = []
+
+        def swapper():
+            time.sleep(0.15)
+            try:
+                conn = http.client.HTTPConnection("127.0.0.1", port,
+                                                  timeout=120)
+                conn.request("POST", "/models/swap", body=json.dumps(
+                    {"name": "default", "file": half}).encode())
+                r = conn.getresponse()
+                r.read()
+                if r.status != 200:
+                    swap_err.append(f"swap status {r.status}")
+                conn.close()
+            except Exception as e:  # noqa: BLE001
+                swap_err.append(str(e))
+
+        sw = threading.Thread(target=swapper)
+        sw.start()
+        _, _, errors = burst(8, 32, on_resp=check)
+        sw.join()
+        fields["serve_swap_failed_requests"] = len(errors)
+        fields["serve_swap_mixed_results"] = mixed[0]
+        fields["serve_swap_completed"] = not swap_err
+        if swap_err:
+            fields["serve_swap_error"] = swap_err[0]
+
+        fields["serve_mean_batch_rows"] = round(
+            srv.metrics.mean_batch_rows(), 2)
+        fields["serve_batches_total"] = srv.metrics.batches_total.value
+        srv.stop()
+    return fields
+
+
 def hist_stream_fields(bst, n_rows: int, num_leaves: int,
                        leaf_batch: int) -> dict:
     """Rows streamed through the bin matrix per tree, measured from the
@@ -647,6 +798,13 @@ def main():
         except Exception as e:
             print(f"leaf_batch ablation failed: {e}", file=sys.stderr)
 
+    serve_fields = {}
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            serve_fields = serve_bench(bst, Xv)
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"serve bench failed: {e}", file=sys.stderr)
+
     ref_fields = ref_same_host_probe(X, y, Xv, yv, iters, max_bin)
 
     print(json.dumps({
@@ -669,6 +827,7 @@ def main():
         **quant_fields,
         **pred_fields,
         **lb_fields,
+        **serve_fields,
         **ref_fields,
         **hist_fields,
     }))

@@ -16,6 +16,9 @@ from .config import Config
 from .dataset import Dataset, Sequence
 from .engine import Booster, CVBooster, PredictSession, cv, train
 from .log import register_logger
+from . import serving
+from .serving import (MicroBatcher, ModelRegistry, PredictionServer,
+                      ServingMetrics)
 from .tree import Tree
 from . import plotting
 from .plotting import (create_tree_digraph, plot_importance, plot_metric,
@@ -31,7 +34,8 @@ except ImportError:  # pragma: no cover
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "CVBooster", "PredictSession", "train",
-           "cv", "Config",
+           "cv", "Config", "serving", "MicroBatcher", "ModelRegistry",
+           "PredictionServer", "ServingMetrics",
            "BinMapper", "Tree", "Sequence", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",
            "register_logger", "plotting", "plot_importance", "plot_metric",

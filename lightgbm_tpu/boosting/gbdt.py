@@ -954,8 +954,24 @@ class GBDT:
         hs = jnp.maximum(jnp.max(jnp.abs(h), axis=1, keepdims=True),
                          1e-30) / nb
         if bool(cfg.stochastic_rounding):
-            u1 = jax.random.uniform(jax.random.fold_in(key, 0), g.shape)
-            u2 = jax.random.uniform(jax.random.fold_in(key, 1), h.shape)
+            # the rounding stream is defined on LOGICAL rows, not the
+            # padded layout: threefry output depends on the draw shape,
+            # and r_pad differs between serial and mesh runs (the mesh
+            # pads to block*num_shards) — drawing at [K, num_data] and
+            # padding with the deterministic 0.5 offset makes every
+            # real row consume identical randomness under any sharding,
+            # the bit-parity precondition of serial-vs-data training.
+            # (Multi-HOST runs interleave per-process pads, so only
+            # same-process-count runs are bit-comparable there.)
+            n = min(self._num_data_global, g.shape[1])
+
+            def draws(salt, width):
+                u = jax.random.uniform(jax.random.fold_in(key, salt),
+                                       (g.shape[0], n))
+                return jnp.pad(u, ((0, 0), (0, width - n)),
+                               constant_values=0.5)
+            u1 = draws(0, g.shape[1])
+            u2 = draws(1, h.shape[1])
         else:
             u1 = jnp.full_like(g, 0.5)
             u2 = jnp.full_like(h, 0.5)

@@ -22,7 +22,7 @@ import numpy as np
 from .config import Config
 from .io import parse_config_file
 
-__all__ = ["main", "run"]
+__all__ = ["main", "run", "serve"]
 
 # IO/driver keys the training engine does not consume (output_model and
 # snapshot_freq stay: engine.train writes periodic checkpoints)
@@ -58,13 +58,56 @@ def _resolve_path(path: str, conf_dir: Optional[str]) -> str:
     return cand if os.path.exists(cand) else path
 
 
+def serve(params: Dict[str, str],
+          conf_dir: Optional[str] = None) -> int:
+    """task=serve: stand up the prediction server (serving/server.py)
+    over one or more registered models. Serve-specific keys (port,
+    max_batch_rows, ...) are not training parameters, so this path
+    never builds a Config."""
+    from .serving import ModelRegistry, PredictionServer
+
+    spec = params.get("model") or params.get("input_model")
+    if not spec:
+        raise SystemExit("task=serve needs model=<model file> "
+                         "(or model=name:file[,name:file...])")
+    registry = ModelRegistry(
+        warmup_rows=int(params.get("warmup_rows", 256)))
+    server = PredictionServer(
+        registry,
+        host=params.get("host", "127.0.0.1"),
+        port=int(params.get("port", 8080)),
+        max_batch_rows=int(params.get("max_batch_rows", 1024)),
+        max_wait_us=int(params.get("max_wait_us", 2000)),
+        max_queue_rows=(int(params["max_queue_rows"])
+                        if "max_queue_rows" in params else None),
+        min_bucket=int(params.get("min_bucket", 16)))
+    for item in str(spec).split(","):
+        item = item.strip()
+        if not item:
+            continue
+        name, sep, path = item.partition(":")
+        if not sep:
+            name, path = params.get("name", "default"), item
+        mv = registry.register(name, _resolve_path(path, conf_dir))
+        print(f"registered {mv.name} v{mv.version} "
+              f"({mv.booster.num_trees()} trees) from {mv.source}")
+    server._bind()
+    print(f"serving on http://{server.host}:{server.port} — endpoints: "
+          "/predict /models /models/swap /models/rollback /healthz "
+          "/metrics")
+    server.serve_forever()
+    return 0
+
+
 def run(params: Dict[str, str]) -> int:
     import lightgbm_tpu as lgb
 
     conf_dir = params.pop("_conf_dir", None)
+    task = (params.get("task") or "train").strip()
+    if task == "serve":
+        return serve(params, conf_dir)
     cfg = Config({k: v for k, v in params.items()
                   if k not in ("valid",)})  # valid handled as list below
-    task = (params.get("task") or "train").strip()
     engine_params = {k: v for k, v in params.items()
                      if Config.canonical_name(k) not in _ENGINE_DROP}
 
@@ -165,8 +208,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
-              "tasks: train | predict | refit | save_binary")
+              "       python -m lightgbm_tpu serve model=<file> "
+              "[port=8080 ...]\n"
+              "tasks: train | predict | refit | save_binary | serve")
         return 0
+    # `python -m lightgbm_tpu serve model=...` — subcommand spelling of
+    # task=serve (the reference CLI is key=value only; serve is ours)
+    if argv[0] == "serve":
+        argv = ["task=serve"] + argv[1:]
     return run(_parse_argv(argv))
 
 

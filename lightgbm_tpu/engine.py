@@ -1065,6 +1065,22 @@ class PredictSession:
     training width hand off zero-copy into the native blocked kernel
     (``capi.c``); everything else falls back to ``Booster.predict``
     with identical results.
+
+    Thread-safety contract (the serving micro-batcher relies on this):
+    every version-dependent piece of state — model version, class
+    count, window offset, tree slice — lives in ONE immutable snapshot
+    tuple. ``predict()`` reads that reference exactly once and serves
+    the whole call from it; ``_refresh()`` builds a complete new tuple
+    and publishes it with a single reference assignment (atomic under
+    the GIL). Concurrent ``predict()`` calls racing a version movement
+    (train / rollback / model reload) therefore each resolve to one
+    WHOLE snapshot — never an old window over new trees, which the
+    previous field-at-a-time reads (`self._use` after
+    ``b._model_version``) allowed. The snapshot's tree list is a slice
+    copy, so later mutations of the Booster's tree list cannot reach
+    it; in-place leaf surgery (``set_leaf_output``) concurrent with a
+    predict remains outside the contract — the serving registry never
+    mutates a registered model, it swaps in a new one.
     """
 
     def __init__(self, booster: Booster, *, start_iteration: int = 0,
@@ -1078,13 +1094,16 @@ class PredictSession:
         self._pred_leaf = pred_leaf
         self._pred_contrib = pred_contrib
         self._extra = dict(kwargs)
-        self._version = None
         self._refresh()
 
     def _refresh(self):
-        """Re-resolve the tree window against the current model."""
+        """Resolve the tree window against the current model into a
+        fresh ``(version, K, lo, trees)`` snapshot; publish and return
+        it. Reads the version FIRST: if the model moves mid-build, the
+        stale snapshot self-heals on the next predict's version check
+        (worst case one extra refresh, never a mixed window)."""
         b = self.booster
-        self._version = b._model_version
+        version = b._model_version
         K = max(1, b._num_class)
         trees = b._all_trees()
         ni = self._num_iteration
@@ -1093,8 +1112,27 @@ class PredictSession:
                   else len(trees) // K)
         lo = self._start_iteration * K
         hi = min(len(trees), (self._start_iteration + ni) * K)
-        self._K, self._lo = K, lo
-        self._use = trees[lo:hi]
+        snap = (version, K, lo, trees[lo:hi])
+        self._snapshot = snap
+        return snap
+
+    # introspection views of the current snapshot (tests, debugging);
+    # serving code must read self._snapshot once instead
+    @property
+    def _version(self):
+        return self._snapshot[0]
+
+    @property
+    def _K(self):
+        return self._snapshot[1]
+
+    @property
+    def _lo(self):
+        return self._snapshot[2]
+
+    @property
+    def _use(self):
+        return self._snapshot[3]
 
     def warmup(self, n_rows: int = 1024) -> "PredictSession":
         """Build every lazy cache now (native handle / packed ensemble /
@@ -1106,8 +1144,10 @@ class PredictSession:
 
     def predict(self, data) -> np.ndarray:
         b = self.booster
-        if b._model_version != self._version:
-            self._refresh()
+        snap = self._snapshot          # ONE read; see class contract
+        if b._model_version != snap[0]:
+            snap = self._refresh()
+        _version, K, lo, use = snap
         fast = (not self._pred_leaf and not self._pred_contrib
                 and isinstance(data, np.ndarray) and data.ndim == 2
                 and data.dtype in (np.float32, np.float64)
@@ -1115,11 +1155,9 @@ class PredictSession:
                 and data.shape[1] == b._max_feature_idx + 1
                 and b._early_stop_config(self._extra) is None)
         if fast:
-            raw = b._native_raw_scores(data, self._use, self._lo,
-                                       self._K)
+            raw = b._native_raw_scores(data, use, lo, K)
             if raw is not None:
-                return b._finalize_scores(raw, self._use, self._K,
-                                          self._raw_score)
+                return b._finalize_scores(raw, use, K, self._raw_score)
         return b.predict(data, start_iteration=self._start_iteration,
                          num_iteration=self._num_iteration,
                          raw_score=self._raw_score,

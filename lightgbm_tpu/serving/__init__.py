@@ -1,0 +1,19 @@
+"""Serving subsystem: micro-batching scheduler, versioned model
+registry with hot-swap, and a metrics-instrumented prediction server.
+
+Layered on :class:`~lightgbm_tpu.engine.PredictSession` (the fast
+per-process primitive of PR 1) — this package is what turns it into a
+service: request coalescing under a latency deadline (``batcher``),
+zero-downtime deploys (``registry``), request-level observability
+(``metrics``), and an HTTP front end (``server``,
+``python -m lightgbm_tpu serve``).
+"""
+
+from .batcher import MicroBatcher, Overloaded, bucket_rows
+from .metrics import Counter, RingHistogram, ServingMetrics
+from .registry import ModelRegistry, ModelVersion
+from .server import PredictionServer
+
+__all__ = ["MicroBatcher", "Overloaded", "bucket_rows", "Counter",
+           "RingHistogram", "ServingMetrics", "ModelRegistry",
+           "ModelVersion", "PredictionServer"]

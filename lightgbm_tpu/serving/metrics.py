@@ -1,0 +1,223 @@
+"""Serving observability: lock-cheap counters + ring-buffer latency
+histograms, rendered in the Prometheus text exposition format.
+
+No reference analog — LightGBM stops at the C API boundary
+(src/c_api.cpp) and ships no service layer; the field set follows what
+the micro-batching scheduler needs to be tuned in production: queue-wait
+vs compute split (is latency admission or the kernel?), batch-size
+distribution (is coalescing happening?), and per-model request/error
+counts (is a deploy failing?).
+
+Design notes:
+
+- Counters take one uncontended ``threading.Lock`` per increment
+  (~100 ns) — CPython attribute ``+=`` is NOT atomic (LOAD/ADD/STORE
+  can interleave at the bytecode boundary), so the lock is the cheapest
+  *correct* primitive; reads are single attribute loads and need none.
+- Histograms write into a fixed-size ring (an index bump + one slot
+  store under the same cheap lock). Percentiles are computed only at
+  scrape time, over the last ``size`` observations, so the hot path
+  never sorts and memory never grows with traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Tuple
+
+import numpy as np
+
+__all__ = ["Counter", "RingHistogram", "ServingMetrics"]
+
+
+class Counter:
+    """Monotonic counter with optional labelled children."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value  # single attribute load: atomic under the GIL
+
+
+class RingHistogram:
+    """Fixed-size ring of float observations (latencies, batch sizes).
+
+    ``observe`` is O(1); quantiles/mean are computed at scrape time over
+    the retained window (the last ``size`` observations), which is the
+    operationally useful view — a serving dashboard wants *recent* p99,
+    not the all-time one that a cumulative histogram would smear.
+    """
+
+    __slots__ = ("_lock", "_buf", "_n")
+
+    def __init__(self, size: int = 4096):
+        self._lock = threading.Lock()
+        self._buf = np.zeros(int(size), np.float64)
+        self._n = 0
+
+    def observe(self, value: float):
+        with self._lock:
+            self._buf[self._n % len(self._buf)] = value
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    def window(self) -> np.ndarray:
+        """Copy of the retained observations (unordered)."""
+        with self._lock:
+            return self._buf[: min(self._n, len(self._buf))].copy()
+
+    def summary(self, qs: Iterable[float] = (0.5, 0.95, 0.99)
+                ) -> Tuple[Dict[float, float], int, float]:
+        """({quantile: value}, total_count, window_mean)."""
+        w = self.window()
+        if w.size == 0:
+            return {q: 0.0 for q in qs}, self._n, 0.0
+        return ({q: float(np.percentile(w, 100.0 * q)) for q in qs},
+                self._n, float(w.mean()))
+
+
+class ServingMetrics:
+    """The metric set of the serving subsystem, one instance per server.
+
+    Exported families (``render()``, Prometheus text format):
+
+    ========================================  =============================
+    field                                     meaning
+    ========================================  =============================
+    serve_requests_total{model=}              requests accepted per model
+    serve_errors_total{model=}                requests that raised
+    serve_overload_total                      fast-failed at admission
+    serve_rows_total                          rows predicted (pre-padding)
+    serve_batches_total                       kernel calls issued
+    serve_batch_rows{quantile=} / _mean       coalesced batch size
+    serve_queue_wait_seconds{quantile=}       enqueue -> batch start
+    serve_compute_seconds{quantile=}          kernel call duration
+    serve_rows_per_s                          window throughput gauge
+    serve_swaps_total / serve_rollbacks_total registry movements
+    serve_uptime_seconds                      since metrics creation
+    ========================================  =============================
+    """
+
+    def __init__(self, hist_size: int = 4096):
+        self._lock = threading.Lock()        # label-map creation only
+        self.requests_total: Dict[str, Counter] = {}
+        self.errors_total: Dict[str, Counter] = {}
+        self.overload_total = Counter()
+        self.rows_total = Counter()
+        self.batches_total = Counter()
+        self.swaps_total = Counter()
+        self.rollbacks_total = Counter()
+        self.batch_rows = RingHistogram(hist_size)
+        self.queue_wait_s = RingHistogram(hist_size)
+        self.compute_s = RingHistogram(hist_size)
+        # (monotonic_ts, rows) per batch: windowed rows/s gauge
+        self._thru = RingHistogram(hist_size)
+        self._thru_ts = RingHistogram(hist_size)
+        self._t0 = time.monotonic()
+
+    # -- recording hooks (called by batcher/registry/server) -----------
+    def _labelled(self, family: Dict[str, Counter], model: str) -> Counter:
+        c = family.get(model)
+        if c is None:
+            with self._lock:
+                c = family.setdefault(model, Counter())
+        return c
+
+    def on_request(self, model: str, rows: int):
+        self._labelled(self.requests_total, model).inc()
+
+    def on_error(self, model: str):
+        self._labelled(self.errors_total, model).inc()
+
+    def on_overload(self):
+        self.overload_total.inc()
+
+    def on_batch(self, rows: int, queue_wait_s: float, compute_s: float):
+        now = time.monotonic()
+        self.batches_total.inc()
+        self.rows_total.inc(rows)
+        self.batch_rows.observe(float(rows))
+        self.queue_wait_s.observe(queue_wait_s)
+        self.compute_s.observe(compute_s)
+        self._thru.observe(float(rows))
+        self._thru_ts.observe(now)
+
+    def mean_batch_rows(self) -> float:
+        return self.batch_rows.summary()[2]
+
+    def rows_per_s(self) -> float:
+        """Throughput over the retained batch window."""
+        ts = self._thru_ts.window()
+        if ts.size < 2:
+            return 0.0
+        span = float(ts.max() - ts.min())
+        if span <= 0:
+            return 0.0
+        return float(self._thru.window().sum()) / span
+
+    # -- export --------------------------------------------------------
+    def render(self) -> str:
+        """Prometheus text exposition (text/plain; version=0.0.4)."""
+        out: List[str] = []
+
+        def counter(name, help_, pairs):
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} counter")
+            for labels, v in pairs:
+                out.append(f"{name}{labels} {v}")
+
+        def summary(name, help_, hist, scale=1.0):
+            qs, cnt, mean = hist.summary()
+            out.append(f"# HELP {name} {help_}")
+            out.append(f"# TYPE {name} summary")
+            for q, v in qs.items():
+                out.append(f'{name}{{quantile="{q:g}"}} {v * scale:.9g}')
+            out.append(f"{name}_count {cnt}")
+            out.append(f"{name}_mean {mean * scale:.9g}")
+
+        counter("serve_requests_total", "Accepted predict requests",
+                [(f'{{model="{m}"}}', c.value)
+                 for m, c in sorted(self.requests_total.items())] or
+                [("", 0)])
+        counter("serve_errors_total", "Requests that raised",
+                [(f'{{model="{m}"}}', c.value)
+                 for m, c in sorted(self.errors_total.items())] or
+                [("", 0)])
+        counter("serve_overload_total",
+                "Requests fast-failed at admission control",
+                [("", self.overload_total.value)])
+        counter("serve_rows_total", "Rows predicted (pre-padding)",
+                [("", self.rows_total.value)])
+        counter("serve_batches_total", "Coalesced kernel calls",
+                [("", self.batches_total.value)])
+        counter("serve_swaps_total", "Model hot-swaps",
+                [("", self.swaps_total.value)])
+        counter("serve_rollbacks_total", "Model rollbacks",
+                [("", self.rollbacks_total.value)])
+        summary("serve_batch_rows", "Rows per coalesced batch",
+                self.batch_rows)
+        summary("serve_queue_wait_seconds",
+                "Enqueue to batch start", self.queue_wait_s)
+        summary("serve_compute_seconds",
+                "Kernel call duration", self.compute_s)
+        out.append("# HELP serve_rows_per_s Window throughput")
+        out.append("# TYPE serve_rows_per_s gauge")
+        out.append(f"serve_rows_per_s {self.rows_per_s():.9g}")
+        out.append("# HELP serve_uptime_seconds Seconds since start")
+        out.append("# TYPE serve_uptime_seconds gauge")
+        out.append(
+            f"serve_uptime_seconds {time.monotonic() - self._t0:.3f}")
+        return "\n".join(out) + "\n"

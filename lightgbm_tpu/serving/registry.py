@@ -1,0 +1,183 @@
+"""Named + versioned model registry with atomic hot-swap and one-step
+rollback.
+
+Deploy contract (the reason this exists — LightGBM's C API loads a
+model once per handle and has no swap story):
+
+1. ``swap()`` loads the incoming model and ``warmup()``s its
+   :class:`~lightgbm_tpu.engine.PredictSession` entirely OFF the
+   serving path — native handle built, device ensemble packed, jit
+   executables compiled — while live traffic keeps reading the old
+   version untouched.
+2. Only then is the active slot CAS'd: publishing is a single
+   reference assignment (atomic under the GIL), so a reader holding
+   yesterday's reference finishes on yesterday's model and the next
+   ``resolve()`` sees the new one. No request ever observes a cold or
+   half-loaded model.
+3. The replaced version stays in the history ring; ``rollback()``
+   republishes it with the same single-assignment CAS (its session
+   caches are still warm, so rollback is instant).
+
+Whole-model guarantee: ``predict()`` resolves the active
+:class:`ModelVersion` exactly once and serves the entire call from that
+snapshot's session — combined with the ``PredictSession`` snapshot
+contract (engine.py) a result can never mix trees of two versions. The
+micro-batcher calls ``predict()`` once per coalesced batch, extending
+the guarantee to every request in the batch.
+
+Registered models are SERVING-ONLY: training, ``rollback_one_iter`` or
+leaf surgery on a registered Booster is outside the contract (swap in a
+new version instead — that is the point of the registry).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .metrics import ServingMetrics
+
+__all__ = ["ModelRegistry", "ModelVersion"]
+
+
+class ModelVersion:
+    """One immutable (booster, warmed session) pair. The registry hands
+    these out by reference; holders may predict on them at any time,
+    even after the version was superseded."""
+
+    __slots__ = ("name", "version", "source", "booster", "session",
+                 "loaded_at", "num_features")
+
+    def __init__(self, name: str, version: int, source: str,
+                 booster, session):
+        self.name = name
+        self.version = version
+        self.source = source
+        self.booster = booster
+        self.session = session
+        self.loaded_at = time.time()
+        self.num_features = booster.num_feature()
+
+    def describe(self) -> dict:
+        return {"name": self.name, "version": self.version,
+                "source": self.source, "loaded_at": self.loaded_at,
+                "num_features": self.num_features,
+                "num_trees": self.booster.num_trees()}
+
+
+class ModelRegistry:
+    """Thread-safe model store: writers serialize on a lock, readers
+    are lock-free (one attribute load resolves the active version)."""
+
+    def __init__(self, *, warmup_rows: int = 256, history: int = 4,
+                 metrics: Optional[ServingMetrics] = None):
+        self.warmup_rows = int(warmup_rows)
+        self.history = int(history)
+        self.metrics = metrics or ServingMetrics()
+        self._lock = threading.Lock()          # writers only
+        self._active: Dict[str, ModelVersion] = {}
+        self._history: Dict[str, List[ModelVersion]] = {}
+        self._next_version: Dict[str, int] = {}
+        self._default: Optional[str] = None
+
+    # -- loading / swapping -------------------------------------------
+    def _load(self, name: str, source, **session_kwargs) -> ModelVersion:
+        """Build + warm a ModelVersion OFF the serving path."""
+        from ..engine import Booster
+        if isinstance(source, Booster):
+            booster, src = source, "<booster>"
+        elif isinstance(source, (str, os.PathLike)):
+            booster, src = Booster(model_file=str(source)), str(source)
+        else:
+            raise TypeError("model source must be a Booster or a model "
+                            f"file path, got {type(source).__name__}")
+        session = booster.predict_session(**session_kwargs)
+        if self.warmup_rows > 0:
+            session.warmup(self.warmup_rows)
+        with self._lock:
+            v = self._next_version.get(name, 0) + 1
+            self._next_version[name] = v
+        return ModelVersion(name, v, src, booster, session)
+
+    def register(self, name: str, source,
+                 **session_kwargs) -> ModelVersion:
+        """Load, warm, then atomically publish ``source`` as the active
+        version of ``name``. The first registered name becomes the
+        default model."""
+        mv = self._load(name, source, **session_kwargs)
+        with self._lock:
+            old = self._active.get(name)
+            if old is not None:
+                hist = self._history.setdefault(name, [])
+                hist.append(old)
+                del hist[:-self.history]
+                self.metrics.swaps_total.inc()
+            # the publish: one reference store, atomic under the GIL —
+            # in-flight readers keep `old`, new resolves see `mv`
+            self._active[name] = mv
+            if self._default is None:
+                self._default = name
+        return mv
+
+    # a swap IS a register on an existing name; the alias keeps the
+    # deploy runbook's vocabulary honest
+    swap = register
+
+    def rollback(self, name: Optional[str] = None) -> ModelVersion:
+        """One-step rollback: republish the previous version of
+        ``name`` (still warm — its session caches survived the swap)."""
+        name = name or self._default
+        with self._lock:
+            hist = self._history.get(name or "")
+            if not hist:
+                raise LookupError(f"no previous version of {name!r} "
+                                  "to roll back to")
+            mv = hist.pop()
+            self._active[name] = mv
+            self.metrics.rollbacks_total.inc()
+        return mv
+
+    def unregister(self, name: str):
+        with self._lock:
+            self._active.pop(name, None)
+            self._history.pop(name, None)
+            if self._default == name:
+                self._default = next(iter(self._active), None)
+
+    # -- serving side (lock-free) -------------------------------------
+    def resolve(self, name: Optional[str] = None) -> ModelVersion:
+        """Active version snapshot — ONE dict read, no lock. Everything
+        reachable from the returned object is immutable."""
+        mv = self._active.get(name or self._default or "")
+        if mv is None:
+            raise LookupError(f"no model registered as "
+                              f"{name or self._default!r}")
+        return mv
+
+    def predict(self, X, name: Optional[str] = None
+                ) -> Tuple[np.ndarray, ModelVersion]:
+        """Predict entirely on one resolved version; returns
+        ``(result, version)`` so callers (the batcher) can tag results
+        with the model that produced them."""
+        mv = self.resolve(name)
+        return mv.session.predict(X), mv
+
+    # -- introspection -------------------------------------------------
+    def models(self) -> List[dict]:
+        with self._lock:
+            out = []
+            for name, mv in sorted(self._active.items()):
+                d = mv.describe()
+                d["default"] = name == self._default
+                hist = self._history.get(name)
+                d["rollback_to"] = hist[-1].version if hist else None
+                out.append(d)
+            return out
+
+    @property
+    def default_name(self) -> Optional[str]:
+        return self._default

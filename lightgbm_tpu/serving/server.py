@@ -1,0 +1,258 @@
+"""Metrics-instrumented prediction server over the micro-batcher and
+model registry — ``python -m lightgbm_tpu serve model=<file>``.
+
+Stdlib-only (``http.server.ThreadingHTTPServer``): each connection gets
+a thread, every ``/predict`` body lands in the per-model
+:class:`~lightgbm_tpu.serving.batcher.MicroBatcher`, so concurrent
+clients coalesce into shared kernel calls regardless of transport.
+
+Endpoints:
+
+- ``POST /predict[?model=name]`` — body either JSON
+  ``{"data": [[...], ...]}`` (``"rows"`` accepted as an alias) or a raw
+  ``.npy`` matrix (``Content-Type: application/x-npy`` or
+  ``application/octet-stream``). JSON in -> JSON
+  ``{"predictions": ..., "model": ..., "version": ...}`` out; npy in ->
+  npy float64 out with the model identity in ``X-Model-Name`` /
+  ``X-Model-Version`` headers (bit-exact round-trip, no text
+  formatting loss). Overload -> ``429`` + ``Retry-After`` with
+  ``{"status": "overloaded", "retriable": true}``.
+- ``GET /models`` — active versions; ``POST /models/swap``
+  ``{"name", "file"}`` hot-swaps (load + warmup off-path, then atomic
+  publish); ``POST /models/rollback`` ``{"name"?}`` republishes the
+  previous version.
+- ``GET /healthz`` — 200 once a model serves, 503 before.
+- ``GET /metrics`` — Prometheus text (field reference: metrics.py).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from .batcher import MicroBatcher, Overloaded
+from .metrics import ServingMetrics
+from .registry import ModelRegistry
+
+__all__ = ["PredictionServer"]
+
+_NPY_TYPES = ("application/x-npy", "application/octet-stream")
+
+
+class PredictionServer:
+    """Own the registry, the per-model batchers and the HTTP front end.
+
+    ``start()`` binds (port 0 picks a free port) and serves from a
+    daemon thread; ``serve_forever()`` serves on the calling thread.
+    """
+
+    def __init__(self, registry: Optional[ModelRegistry] = None, *,
+                 host: str = "127.0.0.1", port: int = 8080,
+                 max_batch_rows: int = 1024, max_wait_us: int = 2000,
+                 max_queue_rows: Optional[int] = None,
+                 min_bucket: int = 16,
+                 metrics: Optional[ServingMetrics] = None):
+        self.metrics = metrics or ServingMetrics()
+        self.registry = registry or ModelRegistry(metrics=self.metrics)
+        if registry is not None and registry.metrics is not self.metrics:
+            registry.metrics = self.metrics
+        self.host, self.port = host, int(port)
+        self._batcher_opts = dict(max_batch_rows=int(max_batch_rows),
+                                  max_wait_us=int(max_wait_us),
+                                  max_queue_rows=max_queue_rows,
+                                  min_bucket=int(min_bucket))
+        self._batchers: Dict[str, MicroBatcher] = {}
+        self._block = threading.Lock()
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- predict plumbing ---------------------------------------------
+    def _batcher(self, name: str) -> MicroBatcher:
+        b = self._batchers.get(name)
+        if b is None:
+            with self._block:
+                b = self._batchers.get(name)
+                if b is None:
+                    b = MicroBatcher(
+                        lambda X, _n=name: self.registry.predict(X, _n),
+                        metrics=self.metrics, model=name,
+                        **self._batcher_opts)
+                    self._batchers[name] = b
+        return b
+
+    def predict(self, X, model: Optional[str] = None):
+        """(result, ModelVersion) through the micro-batcher."""
+        name = model or self.registry.default_name
+        if name is None:
+            raise LookupError("no model registered")
+        return self._batcher(name).submit_tagged(X)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> int:
+        """Bind + serve from a daemon thread; returns the bound port."""
+        self._bind()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http",
+            daemon=True)
+        self._thread.start()
+        return self.port
+
+    def serve_forever(self):
+        self._bind()
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.stop()
+
+    def _bind(self):
+        if self._httpd is not None:
+            return
+        app = self
+
+        class Handler(_Handler):
+            server_app = app
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            # default backlog (5) RSTs bursts of simultaneous connects
+            # well below the concurrency the batcher is built for
+            request_queue_size = 128
+
+        self._httpd = _Server((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]
+
+    def stop(self):
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        for b in self._batchers.values():
+            b.close()
+        self._batchers.clear()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_app: PredictionServer = None  # bound per-server subclass
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------
+    def log_message(self, fmt, *args):  # route through our logger
+        from .. import log
+        log.debug(f"serve: {self.address_string()} {fmt % args}")
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              headers: Optional[dict] = None):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj, headers=None):
+        self._send(code, json.dumps(obj).encode(), "application/json",
+                   headers)
+
+    def _read_body(self) -> bytes:
+        n = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(n) if n > 0 else b""
+
+    # -- GET -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802 (http.server API)
+        app = self.server_app
+        path = urlparse(self.path).path.rstrip("/") or "/"
+        if path == "/healthz":
+            try:
+                mv = app.registry.resolve()
+                self._send_json(200, {"status": "ok",
+                                      "model": mv.name,
+                                      "version": mv.version})
+            except LookupError:
+                self._send_json(503, {"status": "no model registered"})
+        elif path == "/metrics":
+            self._send(200, app.metrics.render().encode(),
+                       "text/plain; version=0.0.4")
+        elif path == "/models":
+            self._send_json(200, {"models": app.registry.models(),
+                                  "default": app.registry.default_name})
+        else:
+            self._send_json(404, {"error": f"unknown path {path}"})
+
+    # -- POST ----------------------------------------------------------
+    def do_POST(self):  # noqa: N802
+        app = self.server_app
+        parsed = urlparse(self.path)
+        path = parsed.path.rstrip("/")
+        try:
+            if path == "/predict":
+                self._predict(app, parsed)
+            elif path == "/models/swap":
+                self._swap(app)
+            elif path == "/models/rollback":
+                self._rollback(app)
+            else:
+                self._send_json(404, {"error": f"unknown path {path}"})
+        except Overloaded as e:
+            self._send_json(429, {"status": "overloaded",
+                                  "retriable": True, "error": str(e)},
+                            headers={"Retry-After": "1"})
+        except (ValueError, TypeError, KeyError, LookupError,
+                json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"{type(e).__name__}: {e}"})
+        except Exception as e:  # noqa: BLE001 — a request must not kill
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _predict(self, app: PredictionServer, parsed):
+        q = parse_qs(parsed.query)
+        model = (q.get("model") or [None])[0]
+        body = self._read_body()
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        is_npy = ctype in _NPY_TYPES or body[:6] == b"\x93NUMPY"
+        if is_npy:
+            X = np.load(io.BytesIO(body), allow_pickle=False)
+        else:
+            req = json.loads(body.decode() or "{}")
+            model = req.get("model", model)
+            data = req.get("data", req.get("rows"))
+            if data is None:
+                raise ValueError('JSON body needs "data" (or "rows"): '
+                                 'a row or list of rows')
+            X = np.asarray(data, np.float64)
+        result, mv = app.predict(X, model)
+        result = np.asarray(result, np.float64)
+        if is_npy:
+            buf = io.BytesIO()
+            np.save(buf, result, allow_pickle=False)
+            self._send(200, buf.getvalue(), "application/x-npy",
+                       headers={"X-Model-Name": mv.name,
+                                "X-Model-Version": mv.version})
+        else:
+            self._send_json(200, {"predictions": result.tolist(),
+                                  "model": mv.name,
+                                  "version": mv.version})
+
+    def _swap(self, app: PredictionServer):
+        req = json.loads(self._read_body().decode() or "{}")
+        name = req.get("name") or app.registry.default_name or "default"
+        source = req.get("file") or req.get("path")
+        if not source:
+            raise ValueError('swap needs "file": path to a model file')
+        mv = app.registry.swap(name, source)
+        self._send_json(200, {"status": "swapped", **mv.describe()})
+
+    def _rollback(self, app: PredictionServer):
+        req = json.loads(self._read_body().decode() or "{}")
+        mv = app.registry.rollback(req.get("name"))
+        self._send_json(200, {"status": "rolled back", **mv.describe()})

@@ -1,0 +1,92 @@
+#!/usr/bin/env bash
+# Serving smoke test (ISSUE 2 satellite): train a tiny model, start
+# `python -m lightgbm_tpu serve`, fire a concurrent predict burst,
+# scrape /metrics, and assert that micro-batching actually engaged
+# (nonzero batches, fewer batches than requests, mean batch size > 1).
+#
+# Usage: scripts/serve_smoke.sh [port]   (default: 8091)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+PORT=${1:-${SERVE_SMOKE_PORT:-8091}}
+WORK=$(mktemp -d -t serve_smoke_XXXX)
+SERVER_PID=""
+cleanup() {
+    [ -n "$SERVER_PID" ] && kill "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+echo "== training a tiny model"
+python - "$WORK" <<'EOF'
+import sys
+import numpy as np
+import lightgbm_tpu as lgb
+work = sys.argv[1]
+rng = np.random.RandomState(0)
+X = rng.normal(size=(2000, 6))
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                 "verbosity": -1},
+                lgb.Dataset(X, label=y, free_raw_data=False), 10)
+bst.save_model(work + "/model.txt")
+np.save(work + "/rows.npy", np.ascontiguousarray(X[:16], np.float64))
+EOF
+
+echo "== starting server on port $PORT"
+python -m lightgbm_tpu serve model="$WORK/model.txt" port="$PORT" \
+    max_wait_us=3000 > "$WORK/server.log" 2>&1 &
+SERVER_PID=$!
+
+for _ in $(seq 1 100); do
+    if curl -fsS "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1; then
+        break
+    fi
+    if ! kill -0 "$SERVER_PID" 2>/dev/null; then
+        echo "server died:"; cat "$WORK/server.log"; exit 1
+    fi
+    sleep 0.2
+done
+curl -fsS "http://127.0.0.1:$PORT/healthz"; echo
+
+echo "== concurrent predict burst (8 clients x 12 npy requests)"
+python - "$WORK" "$PORT" <<'EOF'
+import sys
+import threading
+import urllib.request
+work, port = sys.argv[1], sys.argv[2]
+body = open(work + "/rows.npy", "rb").read()
+errs = []
+
+def client():
+    try:
+        for _ in range(12):
+            rq = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict", data=body,
+                headers={"Content-Type": "application/x-npy"})
+            urllib.request.urlopen(rq, timeout=60).read()
+    except Exception as e:  # noqa: BLE001
+        errs.append(e)
+
+threads = [threading.Thread(target=client) for _ in range(8)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+assert not errs, errs
+print("burst ok: 96 requests, 0 errors")
+EOF
+
+echo "== scraping /metrics"
+METRICS=$(curl -fsS "http://127.0.0.1:$PORT/metrics")
+echo "$METRICS" | grep -E '^serve_(batches|rows)_total|^serve_requests_total|^serve_batch_rows_mean'
+
+BATCHES=$(echo "$METRICS" | awk '/^serve_batches_total/{print int($2)}')
+REQS=$(echo "$METRICS" | awk '/^serve_requests_total/{s+=$2} END{print int(s)}')
+[ "$BATCHES" -ge 1 ] || { echo "FAIL: no batched requests"; exit 1; }
+[ "$BATCHES" -lt "$REQS" ] || { echo "FAIL: no coalescing ($BATCHES batches for $REQS requests)"; exit 1; }
+echo "$METRICS" | awk '/^serve_batch_rows_mean/{exit !($2 > 1)}' \
+    || { echo "FAIL: mean batch size <= 1"; exit 1; }
+
+echo "PASS: $REQS requests coalesced into $BATCHES batches"

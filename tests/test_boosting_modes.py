@@ -34,6 +34,7 @@ def test_dart_scores_match_model(rng):
     assert ((p > 0.5) == y).mean() > 0.85
 
 
+@pytest.mark.slow
 def test_dart_improves_and_differs_from_gbdt(rng):
     X, y = _data(rng)
     ds = lgb.Dataset(X[:2400], label=y[:2400], free_raw_data=False)

@@ -401,6 +401,7 @@ def test_capi_csr_error_paths(capi, rng, tmp_path):
     capi.LGBM_BoosterFree(handle)
 
 
+@pytest.mark.slow
 def test_booster_predict_routes_through_native(capi, rng, tmp_path):
     """On the CPU backend Booster.predict rides the native C predictor
     (RAW from C, transforms in Python): results must match the XLA

@@ -6,6 +6,7 @@ test_engine.py test_categorical_handling)."""
 import numpy as np
 
 import lightgbm_tpu as lgb
+import pytest
 
 
 def _cat_data(rng, n=3000, ncat=30):
@@ -16,6 +17,7 @@ def _cat_data(rng, n=3000, ncat=30):
     return X, y
 
 
+@pytest.mark.slow
 def test_sorted_subset_beats_onehot_on_high_cardinality(rng):
     X, y = _cat_data(rng)
     base = {"objective": "regression", "num_leaves": 31, "verbosity": -1,

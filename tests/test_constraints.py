@@ -31,6 +31,7 @@ def _is_monotone(bst, X, feat, increasing, grid=40):
     return np.all(diffs >= -1e-10) if increasing else np.all(diffs <= 1e-10)
 
 
+@pytest.mark.slow
 def test_monotone_constraints_enforced(rng):
     X, y = _mono_data(rng)
     params = {"objective": "regression", "num_leaves": 31, "verbosity": -1,
@@ -107,6 +108,7 @@ def test_interaction_constraints_respected(rng):
     assert r2 > 0.5
 
 
+@pytest.mark.slow
 def test_feature_fraction_bynode(rng):
     n = 1500
     X = rng.normal(size=(n, 10))
@@ -127,6 +129,7 @@ def test_feature_fraction_bynode(rng):
     np.testing.assert_allclose(bst.predict(X), bst2.predict(X))
 
 
+@pytest.mark.slow
 def test_extra_trees(rng):
     n = 1500
     X = rng.normal(size=(n, 6))
@@ -198,6 +201,7 @@ def test_monotone_intermediate_with_penalty_and_depth(rng):
     assert _is_monotone(bst, X, 1, increasing=False)
 
 
+@pytest.mark.slow
 def test_monotone_advanced_enforced_and_best(rng):
     """monotone_constraints_method=advanced (AdvancedLeafConstraints,
     monotone_constraints.hpp:858): per-(feature, threshold) constraints
@@ -217,6 +221,7 @@ def test_monotone_advanced_enforced_and_best(rng):
     assert fits["advanced"] <= fits["basic"] * 1.001, fits
 
 
+@pytest.mark.slow
 def test_monotone_advanced_deep_geometry(rng):
     """Same 3-level stress as the intermediate regression test: deep
     trees + a strong non-monotone interaction."""
@@ -274,6 +279,7 @@ def test_advanced_mode_scales_to_255_leaves_128_features(rng):
     assert (np.diff(p) >= -1e-6).all()
 
 
+@pytest.mark.slow
 def test_monotone_advanced_composes_with_voting_and_feature(rng):
     """monotone_constraints_method=advanced under the parallel
     learners: the bounds lattice is computed from REPLICATED tree/box

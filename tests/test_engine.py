@@ -25,6 +25,7 @@ def breast_cancer():
     return _split(X, y)
 
 
+@pytest.mark.slow
 def test_binary_auc(breast_cancer):
     X_tr, X_te, y_tr, y_te = breast_cancer
     train = lgb.Dataset(X_tr, label=y_tr, free_raw_data=False)
@@ -40,6 +41,7 @@ def test_binary_auc(breast_cancer):
     assert accuracy_score(y_tr, pred_tr > 0.5) > 0.98
 
 
+@pytest.mark.slow
 def test_regression_l2(rng):
     X, y = load_diabetes(return_X_y=True)
     X_tr, X_te, y_tr, y_te = _split(X, y)
@@ -67,6 +69,7 @@ def test_multiclass(rng):
     assert acc > 0.9
 
 
+@pytest.mark.slow
 def test_early_stopping_and_valid(breast_cancer):
     X_tr, X_te, y_tr, y_te = breast_cancer
     train = lgb.Dataset(X_tr, label=y_tr)
@@ -85,6 +88,7 @@ def test_early_stopping_and_valid(breast_cancer):
     assert len(record["val"]["binary_logloss"]) >= bst.best_iteration
 
 
+@pytest.mark.slow
 def test_model_save_load_roundtrip(tmp_path, breast_cancer):
     X_tr, X_te, y_tr, y_te = breast_cancer
     train = lgb.Dataset(X_tr, label=y_tr)
@@ -111,6 +115,7 @@ def test_weights_change_model(breast_cancer):
     assert p2.mean() > p1.mean()  # upweighted positives push probs up
 
 
+@pytest.mark.slow
 def test_custom_objective(breast_cancer):
     X_tr, X_te, y_tr, y_te = breast_cancer
 
@@ -128,6 +133,7 @@ def test_custom_objective(breast_cancer):
     assert auc > 0.97
 
 
+@pytest.mark.slow
 def test_bagging_and_feature_fraction(breast_cancer):
     X_tr, X_te, y_tr, y_te = breast_cancer
     train = lgb.Dataset(X_tr, label=y_tr)
@@ -139,6 +145,7 @@ def test_bagging_and_feature_fraction(breast_cancer):
     assert auc > 0.97
 
 
+@pytest.mark.slow
 def test_goss(breast_cancer):
     X_tr, X_te, y_tr, y_te = breast_cancer
     train = lgb.Dataset(X_tr, label=y_tr)
@@ -149,6 +156,7 @@ def test_goss(breast_cancer):
     assert auc > 0.97
 
 
+@pytest.mark.slow
 def test_exact_leafwise_matches_batched_reasonably(breast_cancer):
     """leaf_batch=1 (exact best-first) vs default batching: similar quality."""
     X_tr, X_te, y_tr, y_te = breast_cancer
@@ -162,6 +170,7 @@ def test_exact_leafwise_matches_batched_reasonably(breast_cancer):
     assert abs(a1 - a2) < 0.02
 
 
+@pytest.mark.slow
 def test_add_features_from(breast_cancer):
     """Dataset.add_features_from (Dataset::AddFeaturesFrom,
     dataset.cpp:1586): horizontal concat of two constructed datasets."""

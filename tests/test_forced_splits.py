@@ -124,6 +124,7 @@ def test_forced_splits_data_parallel(rng, tmp_path):
     assert dist._all_trees()[0].split_feature[0] == 2
 
 
+@pytest.mark.slow
 def test_dropped_forced_root_drops_subtree(rng, tmp_path):
     """forceSplitMap.erase semantics: when the forced root is dropped
     (starved side), its forced child must NOT fire against whatever

@@ -174,6 +174,7 @@ def test_voting_parallel_small_topk_grows_sane_tree(rng):
     assert np.asarray(rl).max() < nl
 
 
+@pytest.mark.slow
 def test_end_to_end_voting_booster(rng):
     """Full training loop with tree_learner=voting on the 8-device mesh."""
     import lightgbm_tpu as lgb
@@ -287,6 +288,7 @@ def test_advanced_monotone_data_parallel_parity(rng):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_sorted_cat_composes_with_voting(rng):
     """Sorted-subset categorical splits now run under
     tree_learner=voting: the elected-column metadata is gathered
@@ -381,6 +383,7 @@ def test_efb_feature_parallel_rollback_replays_correctly(rng):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 @_sharded_isolated
 def test_feature_shard_storage_matches_serial(rng):
     """feature_shard_storage=true column-shards the device bin matrix
@@ -440,6 +443,7 @@ def test_feature_shard_storage_valid_early_stopping(rng):
                                rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 @_sharded_isolated
 def test_feature_shard_storage_with_efb(rng):
     """EFB + feature_shard_storage: bundled storage decodes back to

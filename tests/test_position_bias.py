@@ -21,6 +21,7 @@ def _click_data(rng, nq=120, per=10):
     return X, clicked, grp, pos
 
 
+@pytest.mark.slow
 def test_position_bias_factors_learn_decay(rng):
     X, y, grp, pos = _click_data(rng)
     ds = lgb.Dataset(X, label=y, group=grp, position=pos)

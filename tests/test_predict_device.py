@@ -8,6 +8,7 @@ from lightgbm_tpu.ops.predict_ensemble import (pack_ensemble,
                                                predict_raw_device)
 
 
+@pytest.mark.slow
 def test_device_matches_host_paths(rng):
     X = rng.normal(size=(3000, 8))
     X[rng.rand(3000, 8) < 0.05] = np.nan

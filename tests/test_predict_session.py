@@ -68,6 +68,7 @@ def test_cross_path_predict_parity(capi, rng, monkeypatch):
     np.testing.assert_allclose(p_host, p_blocked, rtol=1e-12, atol=1e-13)
 
 
+@pytest.mark.slow
 def test_blocked_vs_legacy_leaf_csr_multiclass(capi, rng, monkeypatch):
     """The blocked kernel serves every predict type: leaf indices and
     the CSR route must be bit-identical to the legacy walker; multiclass
@@ -98,6 +99,7 @@ def test_blocked_vs_legacy_leaf_csr_multiclass(capi, rng, monkeypatch):
     np.testing.assert_allclose(p3_b.sum(axis=1), 1.0, rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_predict_layout_reports_blocked(capi, rng, tmp_path,
                                         monkeypatch):
     """LGBM_BoosterGetPredictLayout: 1 when the flattened layout serves

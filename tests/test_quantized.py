@@ -14,6 +14,7 @@ def _data(rng, n=3000):
     return X, y
 
 
+@pytest.mark.slow
 def test_quantized_binary_close_to_full_precision(rng):
     X, y = _data(rng)
     base = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
@@ -41,8 +42,8 @@ def test_quantized_gradients_land_on_int8_grid(rng):
     bst = lgb.train({"objective": "binary", "verbosity": -1,
                      "use_quantized_grad": True, "num_leaves": 7}, ds, 1)
     gb = bst._gbdt
-    g = jnp.asarray(rng.normal(size=(1, 512)).astype(np.float32))
-    h = jnp.asarray(rng.uniform(0.1, 1, size=(1, 512)).astype(np.float32))
+    g = jnp.asarray(rng.normal(size=(1, 8192)).astype(np.float32))
+    h = jnp.asarray(rng.uniform(0.1, 1, size=(1, 8192)).astype(np.float32))
     qg, qh, gs, hs = gb._quantize_jit(g, h, jax.random.PRNGKey(0))
     assert qg.dtype == jnp.int8 and qh.dtype == jnp.int8
     nb = gb.config.num_grad_quant_bins
@@ -51,9 +52,18 @@ def test_quantized_gradients_land_on_int8_grid(rng):
                                rtol=1e-6)
     assert np.abs(np.asarray(qg)).max() <= nb // 2 + 1
     assert np.asarray(qh).min() >= 0
-    # stochastic rounding is unbiased in expectation (dequantized mean)
+    # stochastic rounding is unbiased in expectation: the dequantized
+    # mean must sit within a CLT bound of the true mean. Per-element
+    # rounding error is < 1 grid step (gs) with variance <= gs^2/4, so
+    # the standard error of the mean is gs / (2*sqrt(N)); a 6-sigma
+    # band is the statistically-sound expectation (the old absolute
+    # 0.02 was ~0.6 sigma at N=512 — tighter than the estimator, and
+    # failing for this seed). The key is fixed, so the check is also
+    # fully deterministic on a given PRNG stack.
     deq = np.asarray(qg, np.float32) * float(gs[0])
-    assert abs(deq.mean() - float(jnp.mean(g))) < 0.02
+    tol = 6.0 * float(gs[0]) / (2.0 * np.sqrt(g.size))
+    assert abs(deq.mean() - float(jnp.mean(g))) < tol, (
+        deq.mean(), float(jnp.mean(g)), tol)
 
 
 def test_quantized_int32_histogram_exactness(rng):
@@ -97,6 +107,7 @@ def test_quantized_matches_on_data_parallel_mesh(rng):
                                rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_quantized_renew_leaf_changes_outputs(rng):
     X, y = _data(rng, n=1500)
     base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
@@ -114,6 +125,7 @@ def test_quantized_renew_leaf_changes_outputs(rng):
     assert np.mean((b - y) ** 2) <= np.mean((a - y) ** 2) * 1.05
 
 
+@pytest.mark.slow
 def test_quantized_composes_with_efb(rng):
     """int8 histograms in BUNDLE space: the integer histogram is
     dequantized before the FixHistogram unbundling, so EFB + quantized

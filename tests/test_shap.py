@@ -74,6 +74,7 @@ def test_vectorized_matches_recursive_oracle(rng):
             rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_vectorized_contrib_categorical(rng):
     X = rng.normal(size=(500, 4))
     X[:, 3] = rng.randint(0, 12, size=500)
@@ -89,6 +90,7 @@ def test_vectorized_contrib_categorical(rng):
             rtol=1e-9, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_shap_on_sorted_cat_model(rng):
     """TreeSHAP over sorted-subset categorical splits: contributions
     must still sum to the raw prediction (tree.h:141 local accuracy)."""

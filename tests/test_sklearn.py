@@ -17,6 +17,7 @@ def _cls_data(rng, n=2000, f=10, classes=2):
     return X, y
 
 
+@pytest.mark.slow
 def test_classifier_binary(rng):
     X, y = _cls_data(rng)
     clf = LGBMClassifier(n_estimators=30, num_leaves=15, random_state=42)
@@ -32,6 +33,7 @@ def test_classifier_binary(rng):
     assert clf.feature_importances_.shape == (10,)
 
 
+@pytest.mark.slow
 def test_classifier_multiclass_string_labels(rng):
     X, y = _cls_data(rng, classes=3)
     labels = np.array(["ant", "bee", "cat"])[y]
@@ -44,6 +46,7 @@ def test_classifier_multiclass_string_labels(rng):
     assert proba.shape == (len(y), 3)
 
 
+@pytest.mark.slow
 def test_regressor_with_eval_set(rng):
     X = rng.normal(size=(2000, 8))
     y = X[:, 0] * 2 + np.sin(X[:, 1]) + 0.1 * rng.normal(size=2000)
@@ -59,6 +62,7 @@ def test_regressor_with_eval_set(rng):
     assert mse < np.var(y) * 0.2
 
 
+@pytest.mark.slow
 def test_early_stopping_via_callback(rng):
     X = rng.normal(size=(1200, 5))
     y = (X[:, 0] > 0).astype(int)
@@ -88,6 +92,7 @@ def test_not_fitted_error():
         LGBMClassifier().predict(np.zeros((2, 3)))
 
 
+@pytest.mark.slow
 def test_ranker(rng):
     n_q, q_size, f = 60, 20, 8
     n = n_q * q_size
@@ -138,6 +143,7 @@ def test_callable_eval_metric(rng):
     assert hist[-1] < hist[0]
 
 
+@pytest.mark.slow
 def test_early_stopping_in_fit_via_param(rng):
     """early_stopping_rounds as an estimator param (no explicit
     callback) must arm early stopping inside fit."""

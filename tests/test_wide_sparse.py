@@ -48,6 +48,7 @@ def test_allstate_shape_bundles_and_fits_budget(rng):
         f"device bytes {ds.bins.nbytes} vs dense {dense_bytes}")
 
 
+@pytest.mark.slow
 def test_wide_sparse_training_matches_unbundled(rng):
     n_rows, n_vars, card = 20_000, 64, 16         # 1024 one-hot columns
     X, cats = _one_hot_sparse(rng, n_rows, n_vars, card)
@@ -69,6 +70,7 @@ def test_wide_sparse_training_matches_unbundled(rng):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_wide_sparse_non_exclusive_still_trains(rng):
     """Sparse but NOT mutually exclusive columns: EFB may bundle only
     partially (conflict-bounded); training must still work, just with a
@@ -116,6 +118,7 @@ def test_capacity_model_and_hard_error(rng, monkeypatch):
                   lgb.Dataset(X, label=y, free_raw_data=False), 2)
 
 
+@pytest.mark.slow
 @_sharded_isolated
 def test_wide_non_exclusive_trains_column_sharded(rng):
     """Round-5 answer to the wide NON-bundleable case (the shape class
