@@ -29,6 +29,14 @@ HTTP rows/s + p99 through the micro-batched prediction server at
 sequential, mean coalesced batch size, and a mid-burst hot-swap probe
 (zero failed requests, zero mixed-version results). BENCH_SERVE=0
 skips; BENCH_SERVE_ROWS sets rows per request (default 16).
+ISSUE 3 adds the fused-training probes (`fused_bench`):
+`ms_per_tree_legacy` vs `ms_per_tree_fused` (single-dispatch fused step,
+steady state at eval_period=16), the dispatch-depth ablation
+(`ms_per_tree_fused_ep{1,4,16}`), measured `host_syncs_per_iter`, and
+the fused-vs-legacy valid-AUC bit-parity flag; plus
+`compile_cache_probe`: cold vs warm compile+warmup seconds through the
+persistent XLA compilation cache (subprocess-isolated). BENCH_FUSED=0 /
+BENCH_COMPILE_CACHE=0 skip.
 """
 
 import json
@@ -545,6 +553,107 @@ def serve_bench(bst, Xv) -> dict:
     return fields
 
 
+def fused_bench(ds, dsv, params, iters: int) -> dict:
+    """Fused-vs-legacy steady-state training probes (ISSUE 3).
+
+    Acceptance fields: `ms_per_tree_fused` (eval_period=16 dispatch-
+    ahead) vs `ms_per_tree_legacy`, `host_syncs_per_iter` in fused
+    steady state (tree flushes + score evals per iteration; 0 between
+    eval points), the eval_period 1/4/16 dispatch-depth ablation, and
+    bit-identity of the final valid AUC across drivers."""
+    import lightgbm_tpu as lgb
+    warmup = 2
+    out = {"fused_iters": iters}
+
+    def steady(extra, ep):
+        """Warmup via engine, then time a raw update loop syncing every
+        `ep` iterations (the engine's eval-cadence contract, without
+        paying metric computation inside the timed window)."""
+        bst = lgb.train(dict(params, **extra), ds,
+                        num_boost_round=warmup,
+                        valid_sets=[dsv], valid_names=["v"])
+        g = bst._gbdt
+        syncs0 = g.host_sync_count
+        t0 = time.time()
+        for i in range(iters):
+            bst.update(defer=((i + 1) % ep != 0))
+        g.sync()
+        g.scores.block_until_ready()
+        dt = time.time() - t0
+        return bst, dt, g.host_sync_count - syncs0
+
+    bl, dtl, _ = steady({"fused_train": False}, 1)
+    out["ms_per_tree_legacy"] = round(dtl / iters * 1e3, 2)
+    fused_auc = None
+    for ep in (1, 4, 16):
+        bf, dtf, syncs = steady({}, ep)
+        if not bf._gbdt.fused_ok:
+            out["fused_unavailable"] = bf._gbdt.fused_reason
+            return out
+        out[f"ms_per_tree_fused_ep{ep}"] = round(dtf / iters * 1e3, 2)
+        if ep == 16:
+            out["ms_per_tree_fused"] = out["ms_per_tree_fused_ep16"]
+            out["host_syncs_per_iter"] = round(syncs / iters, 4)
+            fused_auc = float(bf.eval_valid()[0][2])
+    legacy_auc = float(bl.eval_valid()[0][2])
+    out["legacy_valid_auc"] = round(legacy_auc, 6)
+    out["fused_valid_auc"] = round(fused_auc, 6)
+    out["fused_auc_bit_identical"] = bool(fused_auc == legacy_auc)
+    out["fused_speedup"] = round(
+        out["ms_per_tree_legacy"] / out["ms_per_tree_fused"], 3)
+    return out
+
+
+def compile_cache_probe() -> dict:
+    """Cold vs warm compile+warmup seconds through the persistent XLA
+    compilation cache (engine.enable_compilation_cache): the identical
+    tiny training run in two fresh subprocesses sharing one cache dir.
+    Subprocess-isolated so a (de)serialization crash — the known CPU
+    jaxlib hazard — degrades to an error field, never kills the bench."""
+    import subprocess
+    import tempfile
+    script = (
+        "import os, time\n"
+        "import numpy as np\n"
+        "import lightgbm_tpu as lgb\n"
+        "rng = np.random.RandomState(0)\n"
+        "X = rng.normal(size=(4096, 16)).astype(np.float32)\n"
+        "y = (X[:, 0] > 0).astype(np.float32)\n"
+        "ds = lgb.Dataset(X, label=y)\n"
+        "t0 = time.time()\n"
+        "lgb.train(dict(objective='binary', num_leaves=31,\n"
+        "               verbosity=-1), ds, num_boost_round=3)\n"
+        "print('TRAIN_S=%.3f' % (time.time() - t0))\n")
+    out = {}
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory(prefix="bench_cc_") as td:
+        env = dict(os.environ, LIGHTGBM_TPU_CACHE_DIR=td,
+                   LIGHTGBM_TPU_COMPILE_CACHE="1")
+        for tag in ("cold", "warm"):
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", script], cwd=here, env=env,
+                    capture_output=True, text=True, timeout=600)
+                for ln in r.stdout.splitlines():
+                    if ln.startswith("TRAIN_S="):
+                        out[f"compile_cache_{tag}_s"] = float(
+                            ln.split("=", 1)[1])
+                if r.returncode != 0:
+                    out[f"compile_cache_{tag}_error"] = \
+                        (r.stderr or "crashed").strip()[-300:]
+                    break
+            except subprocess.TimeoutExpired:
+                out[f"compile_cache_{tag}_error"] = "timeout"
+                break
+        n_entries = sum(len(fs) for _, _, fs in os.walk(td))
+        out["compile_cache_entries"] = n_entries
+    cold = out.get("compile_cache_cold_s")
+    warm = out.get("compile_cache_warm_s")
+    if cold and warm:
+        out["compile_cache_speedup"] = round(cold / warm, 2)
+    return out
+
+
 def hist_stream_fields(bst, n_rows: int, num_leaves: int,
                        leaf_batch: int) -> dict:
     """Rows streamed through the bin matrix per tree, measured from the
@@ -798,6 +907,22 @@ def main():
         except Exception as e:
             print(f"leaf_batch ablation failed: {e}", file=sys.stderr)
 
+    fused_fields = {}
+    if os.environ.get("BENCH_FUSED", "1") != "0":
+        try:
+            fused_fields = fused_bench(ds, dsv, params, min(iters, 32))
+            print(f"fused bench: {fused_fields}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"fused bench failed: {e}", file=sys.stderr)
+
+    cc_fields = {}
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") != "0":
+        try:
+            cc_fields = compile_cache_probe()
+            print(f"compile cache: {cc_fields}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001
+            print(f"compile cache probe failed: {e}", file=sys.stderr)
+
     serve_fields = {}
     if os.environ.get("BENCH_SERVE", "1") != "0":
         try:
@@ -827,6 +952,8 @@ def main():
         **quant_fields,
         **pred_fields,
         **lb_fields,
+        **fused_fields,
+        **cc_fields,
         **serve_fields,
         **ref_fields,
         **hist_fields,

@@ -14,7 +14,8 @@ from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config
 from .dataset import Dataset, Sequence
-from .engine import Booster, CVBooster, PredictSession, cv, train
+from .engine import (Booster, CVBooster, PredictSession, cv,
+                     enable_compilation_cache, train)
 from .log import register_logger
 from . import serving
 from .serving import (MicroBatcher, ModelRegistry, PredictionServer,
@@ -34,7 +35,8 @@ except ImportError:  # pragma: no cover
 __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "CVBooster", "PredictSession", "train",
-           "cv", "Config", "serving", "MicroBatcher", "ModelRegistry",
+           "cv", "Config", "enable_compilation_cache",
+           "serving", "MicroBatcher", "ModelRegistry",
            "PredictionServer", "ServingMetrics",
            "BinMapper", "Tree", "Sequence", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",

@@ -118,7 +118,11 @@ class DART(GBDT):
         self._ensure_dropped()
         return super().get_training_scores()
 
-    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+    def train_one_iter(self, gradients=None, hessians=None, *,
+                       defer: bool = False) -> bool:
+        # defer is accepted for interface parity and ignored: DART's
+        # drop/restore is per-iteration host work, so it always runs
+        # the eager legacy loop
         cfg = self.config
         self._ensure_dropped()
         drop, preds = self._dropped

@@ -6,12 +6,20 @@ TPU-native analog of the reference boosting layer
 ``bagging.hpp`` / ``goss.hpp``).
 
 Structure (TPU-first):
-- Scores live on device as [num_class, padded_rows] f32; each iteration is:
-  grad/hess (jit) -> sampling mask (jit) -> build_tree (jit, one compiled
-  program per tree — the CUDA learner's whole-loop-on-device shape) ->
-  score gather-update (jit). Only the finished tree's small node arrays
-  come back to host per iteration, mirroring the CUDA learner's
-  scalars-only host boundary (cuda_single_gpu_tree_learner.cpp:246-273).
+- Scores live on device as [num_class, padded_rows] f32; the default
+  driver is the FUSED step (_fused_step_impl): grad/hess -> sampling ->
+  quantize -> per-class build_tree -> score update chained into ONE
+  jitted program per iteration, score buffers donated, and the built
+  TreeArrays kept on device in a pending ring. Host materialization
+  (Tree.from_device) happens in batches at sync points only — eval
+  cadence boundaries and end of training — so the steady-state loop
+  dispatches ahead with zero host syncs between eval points. Configs
+  that need per-iteration host work (custom fobj, linear trees, CEGB,
+  multi-process meshes, position-bias ranking) fall back to the legacy
+  loop (_train_one_iter_legacy: ~5 dispatches + a per-tree sync,
+  mirroring the CUDA learner's scalars-only host boundary,
+  cuda_single_gpu_tree_learner.cpp:246-273); LIGHTGBM_TPU_FUSED_TRAIN=0
+  or fused_train=false pin the legacy loop everywhere.
 - Bagging/GOSS produce a row mask/scale, never a data subset: fixed shapes
   keep one compiled program alive. The mask rides in the histogram count
   channel so min_data_in_leaf counts in-bag rows like the reference.
@@ -485,6 +493,15 @@ class GBDT:
         self._update_score_jit = jax.jit(self._update_score_impl)
         self._goss_jit = jax.jit(self._goss_impl)
 
+        # fused boosting step state (see train_one_iter): the pending
+        # ring of (iteration, shrinkage, device TreeArrays per class,
+        # device should_continue flag), materialized in batches by
+        # sync(); host_sync_count instruments the bench's
+        # host_syncs_per_iter field
+        self._pending: List[Tuple] = []
+        self._fused_jit = None
+        self.host_sync_count = 0
+
         # quantized-gradient training (GradientDiscretizer,
         # gradient_discretizer.hpp:22/.cpp:55-140): gradients are
         # stochastically rounded onto an int8 grid and the histogram runs
@@ -569,6 +586,10 @@ class GBDT:
                 self._cegb_used_rows = jnp.zeros(
                     (self.train_dd.r_pad, F_used), bool)
 
+        # decide the iteration driver LAST (the gate reads _cegb/_mp/...)
+        self.fused_reason = self._fused_gate_reason()
+        self.fused_ok = not self.fused_reason
+
     # ------------------------------------------------------------------
     def _field_init_scores(self, init, n: int, r_pad: int) -> np.ndarray:
         """Metadata init_score -> [K, r_pad] f32.
@@ -652,11 +673,17 @@ class GBDT:
         return jnp.asarray(mat)
 
     # ------------------------------------------------------------------
-    def _grads(self, it: int) -> Tuple[jax.Array, jax.Array]:
-        """[K, R] grad and hess from the objective."""
+    def _grads(self, it: int,
+               scores: Optional[jax.Array] = None
+               ) -> Tuple[jax.Array, jax.Array]:
+        """[K, R] grad and hess from the objective at ``scores``
+        (defaults to the live training scores; the fused step passes its
+        traced score carry instead)."""
         obj = self.objective
+        if scores is None:
+            scores = self.scores
         if obj.num_model_per_iteration > 1:
-            g, h = obj.get_gradients(self.scores.T, self.label_dev,
+            g, h = obj.get_gradients(scores.T, self.label_dev,
                                      self.weight_dev)
             return g.T, h.T
         kwargs = {}
@@ -667,7 +694,7 @@ class GBDT:
             # so gather the host's own score block, compute there, and
             # re-place the result into the sharded global array (the
             # reference's objective is likewise machine-local)
-            loc = self.plan.host_local_cols(self.scores,
+            loc = self.plan.host_local_cols(scores,
                                             self.train_dd.r_local)
             g, h = obj.get_gradients(jnp.asarray(loc[0]),
                                      self._label_local,
@@ -676,7 +703,7 @@ class GBDT:
                         np.asarray(g, np.float32)[None, :]),
                     self.plan.shard_scores(
                         np.asarray(h, np.float32)[None, :]))
-        g, h = obj.get_gradients(self.scores[0], self.label_dev,
+        g, h = obj.get_gradients(scores[0], self.label_dev,
                                  self.weight_dev, **kwargs)
         return g[None, :], h[None, :]
 
@@ -716,12 +743,67 @@ class GBDT:
         scale = jnp.where(sampled, amp, 1.0) * mask
         return g * scale[None, :], h * scale[None, :], mask
 
+    def _bagging_active(self) -> bool:
+        cfg = self.config
+        balanced = (cfg.pos_bagging_fraction < 1.0
+                    or cfg.neg_bagging_fraction < 1.0)
+        return (not self._goss and cfg.bagging_freq > 0
+                and (cfg.bagging_fraction < 1.0 or balanced))
+
+    def _host_bag_mask(self, it: int) -> Optional[jax.Array]:
+        """Regenerate/return the device bagging mask for iteration
+        ``it`` (host RNG draws, no device sync), or None when bagging is
+        off. Shared by the legacy loop and the fused dispatcher so both
+        consume the identical ``_rng_bagging`` stream."""
+        cfg = self.config
+        if not self._bagging_active():
+            return None
+        if it % cfg.bagging_freq == 0 or self._bag_mask is None:
+            R = self.train_dd.r_local
+            balanced = (cfg.pos_bagging_fraction < 1.0
+                        or cfg.neg_bagging_fraction < 1.0)
+            n = self.train_dd.num_data
+            m = np.zeros(R, np.float32)
+            if balanced:
+                # balanced bagging (bagging.hpp:146-165): positives
+                # and negatives subsampled at their own rates
+                lbl = np.asarray(self.train_set.get_label())[:n]
+                pos = np.nonzero(lbl > 0)[0]
+                neg = np.nonzero(lbl <= 0)[0]
+                for rows, frac in ((pos, cfg.pos_bagging_fraction),
+                                   (neg, cfg.neg_bagging_fraction)):
+                    if len(rows) == 0:
+                        continue
+                    cnt = max(1, int(len(rows) * frac))
+                    m[self._rng_bagging.choice(rows, cnt,
+                                               replace=False)] = 1.0
+            elif cfg.bagging_by_query:
+                if self.train_set.group is None:
+                    raise ValueError(
+                        "bagging_by_query needs query/group data on "
+                        "the training Dataset")
+                # sample whole queries (bagging_by_query,
+                # bagging.hpp:36,169) so ranking lists stay intact
+                bounds = self.train_set.query_boundaries()
+                nq = len(bounds) - 1
+                cnt = max(1, int(nq * cfg.bagging_fraction))
+                qs = self._rng_bagging.choice(nq, cnt, replace=False)
+                for q in qs:
+                    m[bounds[q]:bounds[q + 1]] = 1.0
+            else:
+                cnt = max(1, int(n * cfg.bagging_fraction))
+                idx = self._rng_bagging.choice(n, cnt, replace=False)
+                m[idx] = 1.0
+            self._bag_mask = (self.plan.shard_rows(m)
+                              if self.plan is not None
+                              else jnp.asarray(m))
+        return self._bag_mask
+
     def _sampling(self, it: int, g: jax.Array, h: jax.Array):
         """Returns (g, h, count_mask [R] f32). Bagging masks are built
         per process over local rows (the reference's bagging runs on
         each machine's own partition too)."""
         cfg = self.config
-        R = self.train_dd.r_local
         real = self.train_dd.row_leaf0 >= 0
         base_mask = real.astype(jnp.float32)
         if self._goss:
@@ -731,47 +813,8 @@ class GBDT:
                     jax.random.PRNGKey(cfg.bagging_seed), it)
                 return self._goss_jit(g, h, key)
             return g, h, base_mask
-        balanced = (cfg.pos_bagging_fraction < 1.0
-                    or cfg.neg_bagging_fraction < 1.0)
-        if cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
-                                     or balanced):
-            if it % cfg.bagging_freq == 0 or self._bag_mask is None:
-                n = self.train_dd.num_data
-                m = np.zeros(R, np.float32)
-                if balanced:
-                    # balanced bagging (bagging.hpp:146-165): positives
-                    # and negatives subsampled at their own rates
-                    lbl = np.asarray(self.train_set.get_label())[:n]
-                    pos = np.nonzero(lbl > 0)[0]
-                    neg = np.nonzero(lbl <= 0)[0]
-                    for rows, frac in ((pos, cfg.pos_bagging_fraction),
-                                       (neg, cfg.neg_bagging_fraction)):
-                        if len(rows) == 0:
-                            continue
-                        cnt = max(1, int(len(rows) * frac))
-                        m[self._rng_bagging.choice(rows, cnt,
-                                                   replace=False)] = 1.0
-                elif cfg.bagging_by_query:
-                    if self.train_set.group is None:
-                        raise ValueError(
-                            "bagging_by_query needs query/group data on "
-                            "the training Dataset")
-                    # sample whole queries (bagging_by_query,
-                    # bagging.hpp:36,169) so ranking lists stay intact
-                    bounds = self.train_set.query_boundaries()
-                    nq = len(bounds) - 1
-                    cnt = max(1, int(nq * cfg.bagging_fraction))
-                    qs = self._rng_bagging.choice(nq, cnt, replace=False)
-                    for q in qs:
-                        m[bounds[q]:bounds[q + 1]] = 1.0
-                else:
-                    cnt = max(1, int(n * cfg.bagging_fraction))
-                    idx = self._rng_bagging.choice(n, cnt, replace=False)
-                    m[idx] = 1.0
-                self._bag_mask = (self.plan.shard_rows(m)
-                                  if self.plan is not None
-                                  else jnp.asarray(m))
-            mask = self._bag_mask
+        mask = self._host_bag_mask(it)
+        if mask is not None:
             return g * mask, h * mask, mask
         return g, h, base_mask
 
@@ -809,16 +852,22 @@ class GBDT:
         return prep(gradients), prep(hessians)
 
     def _build_one_tree(self, gh: jax.Array, fmask: jax.Array, k: int = 0,
-                        quant_scales: Optional[jax.Array] = None):
-        """One tree on the current gradients; returns device results."""
+                        quant_scales: Optional[jax.Array] = None,
+                        it=None, traced: bool = False):
+        """One tree on the current gradients; returns device results.
+        ``it`` overrides the iteration index (the fused step passes a
+        traced scalar); ``traced`` inlines the builder into an ambient
+        trace instead of dispatching its jit."""
         cfg = self.config
+        if it is None:
+            it = self.iter_
         builder = (self.plan.build_tree if self.plan is not None
-                   else build_tree)
+                   else functools.partial(build_tree, traced=traced))
         # fold both iteration and class index: multiclass trees of one
         # iteration must sample independently (the reference's shared RNG
         # advances per tree)
         key = (jax.random.fold_in(
-            jax.random.fold_in(self._tree_key, self.iter_), k)
+            jax.random.fold_in(self._tree_key, it), k)
             if self._tree_key is not None else None)
         kw = {}
         if quant_scales is not None:
@@ -1102,19 +1151,279 @@ class GBDT:
             node_value=tree_arrays.node_value + adj,
             leaf_values=tree_arrays.leaf_values + adj)
 
+    # -- fused boosting step (ISSUE 3) ---------------------------------
+    # One jitted program per iteration: grads -> sampling -> quantize ->
+    # K tree builds -> score updates, with donated score buffers. Built
+    # TreeArrays stay ON DEVICE in the pending ring and materialize to
+    # host Tree objects in batches at sync points only (engine.train's
+    # eval cadence), so the steady-state inner loop runs dispatch-ahead
+    # with zero host syncs between eval points — the whole-round
+    # on-device shape of the CUDA learner, now including the outer loop.
+
+    def _fused_gate_reason(self) -> str:
+        """Why the fused single-dispatch step cannot drive this run
+        ('' = it can). Anything needing per-iteration HOST work — host
+        gradients, host leaf solves, cross-tree host state — pins the
+        legacy loop; host-RNG sampling masks do NOT (they are generated
+        sync-free at dispatch time and passed in)."""
+        import os
+        cfg = self.config
+        if os.environ.get("LIGHTGBM_TPU_FUSED_TRAIN", "") == "0":
+            return "LIGHTGBM_TPU_FUSED_TRAIN=0"
+        if not bool(cfg.fused_train):
+            return "fused_train=false"
+        if type(self) is not GBDT:
+            return "boosting mode overrides the iteration loop"
+        if self.objective is None:
+            return "custom objective gradients are host-supplied"
+        if bool(cfg.linear_tree):
+            return "linear leaves solve on host raw values"
+        if self._cegb is not None:
+            return "CEGB threads model-level host state"
+        if self._mp:
+            return "multi-process meshes place per-host blocks"
+        if self.plan is not None and not self.plan.supports_fused():
+            return "parallel plan pins the legacy loop"
+        if self.objective.is_ranking and getattr(
+                self.objective, "num_position_ids", 0):
+            return "position-bias estimation updates host state"
+        return ""
+
+    def _fused_step_impl(self, scores, valid_scores, bag_mask, fmask,
+                         it, lr):
+        """The traced iteration body. Pure function of its inputs plus
+        static self state; numerically identical to the legacy loop
+        (same ops, one program). Returns (scores, valid_scores,
+        [TreeArrays]*K, should_continue flag) — all on device."""
+        from .. import profiler
+        cfg = self.config
+        with profiler.phase("grads"):
+            g, h = self._grads(it, scores)
+        with profiler.phase("sampling"):
+            if self._goss:
+                # GOSS starts after 1/learning_rate iterations
+                # (goss.hpp); a traced-iteration cond replaces the
+                # legacy host branch
+                thresh = int(1.0 / cfg.learning_rate)
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(cfg.bagging_seed), it)
+                base = (self.train_dd.row_leaf0 >= 0).astype(jnp.float32)
+                g, h, count_mask = jax.lax.cond(
+                    it >= thresh,
+                    lambda gg, hh: self._goss_impl(gg, hh, key),
+                    lambda gg, hh: (gg, hh, base), g, h)
+            elif self._bagging_active():
+                g, h, count_mask = g * bag_mask, h * bag_mask, bag_mask
+            else:
+                count_mask = bag_mask    # base real-row mask
+            g_true, h_true = g, h
+            if self._quant:
+                qg, qh, q_gs, q_hs = self._quantize_impl(
+                    g, h, jax.random.fold_in(self._quant_key, it))
+                count_i8 = count_mask.astype(jnp.int8)
+        new_scores = scores
+        new_valid = list(valid_scores)
+        trees = []
+        grews = []
+        for k in range(self.K):
+            if self._quant:
+                gh = jnp.stack([qg[k], qh[k], count_i8], axis=1)
+                qsk = {"quant_scales": jnp.stack([q_gs[k], q_hs[k]])}
+            else:
+                gh = jnp.stack([g[k], h[k], count_mask], axis=1)
+                qsk = {}
+            with profiler.phase("build"):
+                tree_arrays, row_leaf, valid_rls = self._build_one_tree(
+                    gh, fmask, k, it=it, traced=self.plan is None, **qsk)
+                if self._quant and bool(cfg.quant_train_renew_leaf):
+                    tree_arrays = self._renew_leaf_impl(
+                        tree_arrays, row_leaf, g_true[k], h_true[k])
+            grew = tree_arrays.num_leaves > 1
+            with profiler.phase("update"):
+                # score updates apply only when the tree grew — the
+                # device form of the legacy num_leaves>1 host check
+                upd = self._update_score_impl(
+                    new_scores[k], tree_arrays.leaf_values, row_leaf, lr)
+                new_scores = new_scores.at[k].set(
+                    jnp.where(grew, upd, new_scores[k]))
+                for vi, vrl in enumerate(valid_rls):
+                    vupd = self._update_score_impl(
+                        new_valid[vi][k], tree_arrays.leaf_values, vrl,
+                        lr)
+                    new_valid[vi] = new_valid[vi].at[k].set(
+                        jnp.where(grew, vupd, new_valid[vi][k]))
+            trees.append(tree_arrays)
+            grews.append(grew)
+        cont = jnp.any(jnp.stack(grews))
+        return new_scores, tuple(new_valid), trees, cont
+
+    def _fused_data_args(self):
+        """The large per-instance device arrays the fused step reads,
+        as a pytree jit ARGUMENT. On jax 0.4.x, closed-over concrete
+        arrays are embedded into the lowered module as dense HLO
+        constants — a multi-MB (at Higgs scale, multi-hundred-MB)
+        constant per dataset that XLA then burns compile time
+        constant-folding over. Passing them as arguments keeps the
+        program data-free like the legacy build_tree jit."""
+        return dict(
+            bins=self.train_dd.bins,
+            row_leaf0=self.train_dd.row_leaf0,
+            label=self.label_dev,
+            weight=self.weight_dev,
+            bins_cm=self._bins_cm,
+            valid_bins=tuple(dd.bins for dd in self.valid_dd),
+            valid_rl0=tuple(dd.row_leaf0 for dd in self.valid_dd))
+
+    def _fused_step_entry(self, scores, valid_scores, bag_mask, fmask,
+                          it, lr, data):
+        """jit entry point: rebinds ``data``'s tracers onto self for
+        the duration of the trace (restored in finally), so every read
+        the step body makes of the big arrays resolves to a program
+        argument instead of a closure constant. Runs only while
+        TRACING — steady-state dispatches hit the compiled cache and
+        never re-enter Python here."""
+        saved = (self.train_dd.bins, self.train_dd.row_leaf0,
+                 self.label_dev, self.weight_dev, self._bins_cm,
+                 [dd.bins for dd in self.valid_dd],
+                 [dd.row_leaf0 for dd in self.valid_dd])
+        try:
+            self.train_dd.bins = data["bins"]
+            self.train_dd.row_leaf0 = data["row_leaf0"]
+            self.label_dev = data["label"]
+            self.weight_dev = data["weight"]
+            self._bins_cm = data["bins_cm"]
+            for dd, b, rl in zip(self.valid_dd, data["valid_bins"],
+                                 data["valid_rl0"]):
+                dd.bins, dd.row_leaf0 = b, rl
+            return self._fused_step_impl(scores, valid_scores, bag_mask,
+                                         fmask, it, lr)
+        finally:
+            (self.train_dd.bins, self.train_dd.row_leaf0, self.label_dev,
+             self.weight_dev, self._bins_cm, vb, vr) = saved
+            for dd, b, rl in zip(self.valid_dd, vb, vr):
+                dd.bins, dd.row_leaf0 = b, rl
+
+    def _fused_dispatch(self):
+        """Enqueue one fused iteration: a single jit dispatch, no host
+        sync. Host-RNG inputs (bagging mask, feature mask) are drawn
+        here — pure host computation — so fused and legacy consume the
+        identical RNG streams in the identical order."""
+        it = self.iter_
+        mask = self._host_bag_mask(it)
+        if mask is None:
+            mask = (self.train_dd.row_leaf0 >= 0).astype(jnp.float32)
+        fmask = self._feature_mask()
+        if (self._bins_cm is None and self.plan is None
+                and self._bundle_meta is None
+                and resolve_impl(self.config.hist_impl) == "native"):
+            # the lazy column-major copy must exist BEFORE tracing: a
+            # trace-time build inside _build_one_tree would store a
+            # tracer on self
+            self._bins_cm = jnp.asarray(self.train_dd.bins.T)
+        if self._fused_jit is None:
+            # donate the score carries on accelerators: each iteration
+            # writes into the previous buffers instead of allocating
+            # K*R fresh. The CPU backend pins NO-donation: np.asarray
+            # of a CPU jax array is zero-copy, so metric/eval code can
+            # still hold views of the previous score buffers when the
+            # next donated in-place write lands (observed as corrupted
+            # valid metrics + runtime aborts).
+            donate = (0, 1) if jax.default_backend() != "cpu" else ()
+            self._fused_jit = jax.jit(self._fused_step_entry,
+                                      donate_argnums=donate)
+        scores, valid_scores, trees, cont = self._fused_jit(
+            self.scores, tuple(self.valid_scores), mask, fmask,
+            jnp.asarray(it, jnp.int32),
+            jnp.asarray(self.shrinkage, jnp.float32),
+            self._fused_data_args())
+        self.scores = scores
+        self.valid_scores = list(valid_scores)
+        self._pending.append((it, float(self.shrinkage), trees, cont))
+        self.iter_ += 1
+
+    def sync(self) -> bool:
+        """Materialize every deferred iteration's device trees into host
+        ``Tree`` models with ONE device transfer, and run the deferred
+        stop check (the device should_continue flags of the pending
+        ring). Returns True when training must stop — a no-split
+        iteration was found; it and everything dispatched after it are
+        dropped (their score updates were device no-ops, so the live
+        scores are already correct). No-op False when nothing pends."""
+        if not self._pending:
+            return False
+        pending, self._pending = self._pending, []
+        host = jax.device_get([(trees, cont)
+                               for (_, _, trees, cont) in pending])
+        self.host_sync_count += 1
+        bm = self.train_set.bin_mappers
+        uf = self.train_set.used_features
+        stop = False
+        kept = 0
+        for (it, shrink, _, _), (trees_h, cont) in zip(pending, host):
+            if not bool(cont) and it > 0:
+                # drop the no-op iteration (and its dispatch-ahead
+                # successors, which trained on unchanged scores),
+                # reference gbdt.cpp:441-447
+                stop = True
+                break
+            for k, tree in enumerate(Tree.from_device_batch(
+                    trees_h, bm, uf, shrink)):
+                bias = self._init_scores[k]
+                if it == 0 and abs(bias) > kEpsilon:
+                    # AddBias (gbdt.cpp:416): fold init score into the
+                    # first tree. Only the host model needs it here —
+                    # the fused path never keeps device trees (DART,
+                    # which does, is legacy-only).
+                    tree.leaf_value += bias
+                    tree.internal_value += bias
+                self.models.append(tree)
+            kept += 1
+        self.iter_ = pending[0][0] + kept
+        return stop
+
     def train_one_iter(self, gradients: Optional[np.ndarray] = None,
-                       hessians: Optional[np.ndarray] = None) -> bool:
-        """Returns True when training should stop (no splits possible)."""
-        if gradients is None or hessians is None:
-            g, h = self._grads(self.iter_)
-        else:
-            g, h = self._prep_custom_gh(gradients, hessians)
-        g, h, count_mask = self._sampling(self.iter_, g, h)
-        g_true, h_true = g, h
-        if self._quant:
-            qg, qh, q_gs, q_hs = self._quantize_jit(
-                g, h, jax.random.fold_in(self._quant_key, self.iter_))
-            count_i8 = count_mask.astype(jnp.int8)
+                       hessians: Optional[np.ndarray] = None, *,
+                       defer: bool = False):
+        """One boosting iteration.
+
+        Default (eager) contract: dispatch AND materialize, returning
+        True when training should stop (no splits possible).
+
+        ``defer=True`` with the fused step active: dispatch the whole
+        iteration as one jitted program and return None with ZERO host
+        syncs; trees stay on device until :meth:`sync` (engine.train
+        syncs on its ``eval_period`` cadence). Custom gradients and
+        fallback configs run the legacy loop eagerly either way.
+        """
+        if gradients is not None or hessians is not None \
+                or not self.fused_ok:
+            if self.sync():        # drain any deferred work first
+                return True
+            return self._train_one_iter_legacy(gradients, hessians)
+        self._fused_dispatch()
+        if defer:
+            return None
+        return self.sync()
+
+    def _train_one_iter_legacy(self,
+                               gradients: Optional[np.ndarray] = None,
+                               hessians: Optional[np.ndarray] = None
+                               ) -> bool:
+        """Per-iteration host loop (~5 dispatches + a per-tree sync);
+        returns True when training should stop (no splits possible)."""
+        from .. import profiler
+        with profiler.phase("grads"):
+            if gradients is None or hessians is None:
+                g, h = self._grads(self.iter_)
+            else:
+                g, h = self._prep_custom_gh(gradients, hessians)
+        with profiler.phase("sampling"):
+            g, h, count_mask = self._sampling(self.iter_, g, h)
+            g_true, h_true = g, h
+            if self._quant:
+                qg, qh, q_gs, q_hs = self._quantize_jit(
+                    g, h, jax.random.fold_in(self._quant_key, self.iter_))
+                count_i8 = count_mask.astype(jnp.int8)
 
         fmask = self._feature_mask()
         linear = bool(self.config.linear_tree)
@@ -1126,11 +1435,12 @@ class GBDT:
             else:
                 gh = jnp.stack([g[k], h[k], count_mask], axis=1)
                 qsk = {}
-            tree_arrays, row_leaf, valid_rls = self._build_one_tree(
-                gh, fmask, k, **qsk)
-            if self._quant and bool(self.config.quant_train_renew_leaf):
-                tree_arrays = self._renew_jit(tree_arrays, row_leaf,
-                                              g_true[k], h_true[k])
+            with profiler.phase("build"):
+                tree_arrays, row_leaf, valid_rls = self._build_one_tree(
+                    gh, fmask, k, **qsk)
+                if self._quant and bool(self.config.quant_train_renew_leaf):
+                    tree_arrays = self._renew_jit(tree_arrays, row_leaf,
+                                                  g_true[k], h_true[k])
             host = jax.tree.map(np.asarray, tree_arrays)
             num_leaves_trained = int(host.num_leaves)
             shrink = self.shrinkage
@@ -1141,32 +1451,35 @@ class GBDT:
                                         h_true[k], shrink)
             if num_leaves_trained > 1:
                 should_continue = True
-                if linear:
-                    # linear outputs live on host (raw feature values);
-                    # scores updated from the per-row linear deltas
-                    delta = self._linear_score_delta(
-                        tree, self.train_set.raw_values, row_leaf,
-                        self.train_dd.r_pad)
-                    self.scores = self.scores.at[k].add(jnp.asarray(delta))
-                    for vi, vrl in enumerate(valid_rls):
-                        vds = self.valid_sets[vi]
-                        vdelta = self._linear_score_delta(
-                            tree, vds.raw_values, vrl,
-                            self.valid_dd[vi].r_pad)
-                        self.valid_scores[vi] = self.valid_scores[vi] \
-                            .at[k].add(jnp.asarray(vdelta))
-                else:
-                    lr = jnp.asarray(shrink, jnp.float32)
-                    self.scores = self.scores.at[k].set(
-                        self._update_score_jit(
-                            self.scores[k], tree_arrays.leaf_values,
-                            row_leaf, lr))
-                    for vi, vrl in enumerate(valid_rls):
-                        self.valid_scores[vi] = \
-                            self.valid_scores[vi].at[k].set(
-                                self._update_score_jit(
-                                    self.valid_scores[vi][k],
-                                    tree_arrays.leaf_values, vrl, lr))
+                with profiler.phase("update"):
+                    if linear:
+                        # linear outputs live on host (raw feature
+                        # values); scores updated from the per-row
+                        # linear deltas
+                        delta = self._linear_score_delta(
+                            tree, self.train_set.raw_values, row_leaf,
+                            self.train_dd.r_pad)
+                        self.scores = self.scores.at[k].add(
+                            jnp.asarray(delta))
+                        for vi, vrl in enumerate(valid_rls):
+                            vds = self.valid_sets[vi]
+                            vdelta = self._linear_score_delta(
+                                tree, vds.raw_values, vrl,
+                                self.valid_dd[vi].r_pad)
+                            self.valid_scores[vi] = self.valid_scores[vi] \
+                                .at[k].add(jnp.asarray(vdelta))
+                    else:
+                        lr = jnp.asarray(shrink, jnp.float32)
+                        self.scores = self.scores.at[k].set(
+                            self._update_score_jit(
+                                self.scores[k], tree_arrays.leaf_values,
+                                row_leaf, lr))
+                        for vi, vrl in enumerate(valid_rls):
+                            self.valid_scores[vi] = \
+                                self.valid_scores[vi].at[k].set(
+                                    self._update_score_jit(
+                                        self.valid_scores[vi][k],
+                                        tree_arrays.leaf_values, vrl, lr))
             bias = self._init_scores[k]
             if self.iter_ == 0 and abs(bias) > kEpsilon:
                 # AddBias (gbdt.cpp:416): fold init score into first tree
@@ -1211,6 +1524,7 @@ class GBDT:
         the binned matrix (threshold_bin traversal — the same decisions the
         device builder made), so repeated rollbacks work without keeping
         per-tree device state."""
+        self.sync()        # deferred trees must exist before undoing one
         if self.iter_ <= 0:
             return
         uf = self.train_set.used_features
@@ -1280,6 +1594,7 @@ class GBDT:
         exactly the reference's distributed-learner behavior."""
         dd = self.train_dd if which < 0 else self.valid_dd[which]
         arr = self.scores if which < 0 else self.valid_scores[which]
+        self.host_sync_count += 1      # device -> host copy = one sync
         if self.plan is not None:
             return self.plan.host_local_cols(arr, dd.num_data).T
         return np.asarray(arr)[:, :dd.num_data].T

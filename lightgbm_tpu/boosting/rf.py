@@ -77,7 +77,10 @@ class RF(GBDT):
     def _grads(self, it: int):
         return self._g0, self._h0
 
-    def train_one_iter(self, gradients=None, hessians=None) -> bool:
+    def train_one_iter(self, gradients=None, hessians=None, *,
+                       defer: bool = False) -> bool:
+        # defer accepted for interface parity, ignored (RF averages
+        # scores with host-side iteration weights — eager loop only)
         if gradients is not None or hessians is not None:
             raise ValueError("RF mode does not support custom gradients")
         cfg = self.config

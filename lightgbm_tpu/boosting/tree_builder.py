@@ -118,23 +118,25 @@ def _round_int(x):
     return jnp.floor(x + 0.5)
 
 
-def build_tree(*args, hist_impl: str = "auto", **kwargs):
+def build_tree(*args, hist_impl: str = "auto", traced: bool = False,
+               **kwargs):
     """Unjitted entry: resolves ``hist_impl='auto'`` EAGERLY (the Pallas
     probe must compile outside any trace — staged into an ambient trace
     its try/except would pass vacuously) and dispatches to the jitted
-    core. Same contract as :func:`_build_tree_jit` below."""
+    core. Same contract as :func:`_build_tree_impl` below.
+
+    ``traced=True`` runs the plain (unjitted) core for callers that are
+    ALREADY inside a trace — the fused boosting step of gbdt.py — so the
+    build inlines into the enclosing program instead of nesting a pjit
+    call boundary."""
+    if traced:
+        return _build_tree_impl(*args, hist_impl=resolve_impl(hist_impl),
+                                **kwargs)
     return _build_tree_jit(*args, hist_impl=resolve_impl(hist_impl),
                            **kwargs)
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
-                     "split_params", "axis_name", "hist_dtype", "hist_impl",
-                     "block_rows", "feature_fraction_bynode",
-                     "parallel_mode", "top_k", "bundle_bins", "mono_method",
-                     "forced", "hist_sub", "feature_sharded"))
-def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
+def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
                is_cat_pf: jax.Array, feature_mask: jax.Array,
                *, num_leaves: int, leaf_batch: int, max_depth: int,
@@ -1413,3 +1415,13 @@ def _build_tree_jit(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         return (state["tree"], state["row_leaf"],
                 state["valid_row_leaf"], cegb_out)
     return state["tree"], state["row_leaf"], state["valid_row_leaf"]
+
+
+_build_tree_jit = functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
+                     "split_params", "axis_name", "hist_dtype", "hist_impl",
+                     "block_rows", "feature_fraction_bynode",
+                     "parallel_mode", "top_k", "bundle_bins", "mono_method",
+                     "forced", "hist_sub", "feature_sharded"))(
+    _build_tree_impl)

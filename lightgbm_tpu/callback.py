@@ -4,6 +4,24 @@ Analog of the reference Python callback protocol
 (``python-package/lightgbm/callback.py:40-503``): ``CallbackEnv`` tuples,
 ``EarlyStopException`` control flow, and the four stock callbacks
 (early_stopping, log_evaluation, record_evaluation, reset_parameter).
+
+Metric-consumption contract (engine.train reads these attributes to
+avoid computing metrics nobody looks at):
+
+- ``needs_eval`` (default True): False on an after-iteration callback
+  declares it never reads ``env.evaluation_result_list``; when no
+  after-callback needs evals and early stopping is off, engine.train
+  skips metric evaluation entirely.
+- ``consumes_train_metrics`` (default True): False declares the
+  callback ignores training-set entries. ``early_stopping`` sets it —
+  train metrics never trigger stopping — so ``is_provide_training_metric``
+  with ONLY early stopping active no longer pays a full train-set eval
+  every round.
+
+Callbacks observe metrics on engine.train's ``eval_period`` cadence
+(config.py): with eval_period=N, after-callbacks fire with evaluation
+results every N-th iteration (and the final one); ``env.iteration``
+still reports the true iteration index.
 """
 
 from __future__ import annotations
@@ -147,4 +165,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 raise EarlyStopException(best_iter[i], best_score_list[i])
             _final_iteration_check(env, metric, i)
     _callback.order = 30
+    # stopping never triggers on training metrics (the name ==
+    # "training" skip above), so engine.train may skip the train-set
+    # eval when early stopping is the only metric consumer
+    _callback.consumes_train_metrics = False
     return _callback

@@ -102,6 +102,12 @@ def serve(params: Dict[str, str],
 def run(params: Dict[str, str]) -> int:
     import lightgbm_tpu as lgb
 
+    # persistent XLA compile cache (engine.enable_compilation_cache):
+    # CLI processes are one-shot, so without it every invocation repays
+    # the full compile+warmup; with it only the first run on a host does
+    from .engine import enable_compilation_cache
+    enable_compilation_cache()
+
     conf_dir = params.pop("_conf_dir", None)
     task = (params.get("task") or "train").strip()
     if task == "serve":

@@ -168,6 +168,22 @@ PARAMS: Dict[str, ParamSpec] = {
         _p("quant_train_renew_leaf", False, bool),
         _p("stochastic_rounding", True, bool),
         # -- TPU-specific learning control (no reference analog) --
+        _p("fused_train", True, bool,
+           doc="drive training with the fused single-dispatch boosting "
+               "step (grads+sampling+build+update in one jitted program, "
+               "trees materialized in batches at eval points). false "
+               "pins the legacy per-phase dispatch loop; configs the "
+               "fused step cannot express (custom fobj, linear trees, "
+               "CEGB, multi-process meshes) fall back automatically. "
+               "LIGHTGBM_TPU_FUSED_TRAIN=0 pins legacy from the env"),
+        _p("eval_period", 1, int, aliases=("eval_freq",),
+           check=lambda v: v >= 1,
+           doc="engine.train eval cadence: callbacks and early stopping "
+               "observe metrics every eval_period iterations (plus the "
+               "final one). 1 = reference-parity per-iteration "
+               "evaluation; larger values let the fused trainer run "
+               "dispatch-ahead with zero host syncs between eval "
+               "points"),
         _p("leaf_batch", 16, int,
            doc="Leaves split per on-device round; 1 = exact best-first"
                " (reference semantics), >1 batches frontier growth to keep the"

@@ -26,7 +26,54 @@ from .metrics import create_metrics, Metric
 from .objectives import create_objective, Objective
 from .tree import Tree
 
-__all__ = ["Booster", "PredictSession", "train", "cv", "CVBooster"]
+__all__ = ["Booster", "PredictSession", "train", "cv", "CVBooster",
+           "enable_compilation_cache"]
+
+
+def enable_compilation_cache():
+    """Wire jax's persistent XLA compilation cache so the multi-second
+    compile+warmup of the training/predict programs is paid once per
+    HOST instead of once per process (r05 measured 6.27 s compile+warmup
+    per run). Default dir ``~/.cache/lightgbm_tpu/xla``;
+    ``LIGHTGBM_TPU_CACHE_DIR`` overrides it,
+    ``LIGHTGBM_TPU_COMPILE_CACHE=0`` disables, and ``=1`` force-enables
+    on the CPU backend (where it is otherwise opt-in — this jaxlib has
+    segfaulted deserializing CPU executables). Called by :func:`train`
+    and the CLI; safe to call repeatedly and never overrides a cache
+    dir the user already configured in jax. Returns the active cache
+    dir, or None when disabled/unsupported."""
+    import os
+    on = os.environ.get("LIGHTGBM_TPU_COMPILE_CACHE", "")
+    if on == "0":
+        return None
+    import jax
+    cur = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if cur:
+        return cur
+    if jax.default_backend() == "cpu" and on != "1":
+        # CPU is OPT-IN (LIGHTGBM_TPU_COMPILE_CACHE=1): this jaxlib's
+        # CPU executable (de)serialization has produced hard segfaults
+        # (see tests/conftest.py round-5 note); accelerator backends
+        # default on, where the cache pays the compile+warmup once per
+        # host
+        return None
+    d = os.environ.get("LIGHTGBM_TPU_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "lightgbm_tpu", "xla")
+    try:
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+    except Exception as e:  # unwritable dir / ancient jax: train anyway
+        log.warning(f"persistent compilation cache unavailable: {e}")
+        return None
+    # cache every program: the helper jits are small and fast to
+    # compile, but a warm process should pay ZERO recompiles
+    for k, v in (("jax_persistent_cache_min_entry_size_bytes", -1),
+                 ("jax_persistent_cache_min_compile_time_secs", 0.0)):
+        try:
+            jax.config.update(k, v)
+        except Exception:
+            pass
+    return d
 
 
 class Booster:
@@ -164,8 +211,16 @@ class Booster:
         self._valid_names.append(name)
         return self
 
-    def update(self, train_set=None, fobj: Optional[Callable] = None) -> bool:
-        """One boosting iteration; True if stopped (no more splits)."""
+    def update(self, train_set=None, fobj: Optional[Callable] = None, *,
+               defer: bool = False):
+        """One boosting iteration; True if stopped (no more splits).
+
+        ``defer=True`` lets the fused trainer dispatch the iteration
+        without materializing its trees (returns None); they land in
+        ``self._trees`` at the next sync point — engine.train's eval
+        cadence, or any model-reading call (predict/save/dump), which
+        sync transparently. Legacy/fallback configs ignore ``defer``
+        and return the stop bool eagerly."""
         self._ensure_gbdt()
         self._model_version += 1
         if fobj is not None:
@@ -175,7 +230,13 @@ class Booster:
                     "(c_api LGBM_BoosterUpdateOneIterCustom contract)")
             grad, hess = fobj(self._current_pred_for_fobj(), self.train_set)
             return self._gbdt.train_one_iter(grad, hess)
-        return self._gbdt.train_one_iter()
+        return self._gbdt.train_one_iter(defer=defer)
+
+    def _sync_trees(self):
+        """Materialize any trees the fused trainer deferred (no-op when
+        nothing pends) so model readers see the full ensemble."""
+        if self._gbdt is not None:
+            self._gbdt.sync()
 
     def _current_pred_for_fobj(self):
         # get_training_scores (not eval_scores): DART applies its dropout
@@ -298,6 +359,7 @@ class Booster:
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
         """Batch prediction on raw features
         (gbdt_prediction.cpp / predictor.hpp analog)."""
+        self._sync_trees()
         from .dataset import Dataset
         # scipy sparse rides the native CSR predictor on the CPU
         # backend without ever densifying; all other paths (and route
@@ -700,6 +762,7 @@ class Booster:
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:
+        self._sync_trees()
         K = max(1, self._num_class)
         trees = self._all_trees()
         if num_iteration is not None and num_iteration > 0:
@@ -755,6 +818,7 @@ class Booster:
         """Model as a JSON-ready dict (GBDT::DumpModel,
         gbdt_model_text.cpp:21; same schema as the reference python
         Booster.dump_model)."""
+        self._sync_trees()
         K = max(1, self._num_class)
         trees = self._all_trees()
         total_iter = len(trees) // K
@@ -1103,6 +1167,7 @@ class PredictSession:
         stale snapshot self-heals on the next predict's version check
         (worst case one extra refresh, never a mixed window)."""
         b = self.booster
+        b._sync_trees()    # materialize any deferred fused-train trees
         version = b._model_version
         K = max(1, b._num_class)
         trees = b._all_trees()
@@ -1173,10 +1238,19 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
           feval=None, init_model=None, keep_training_booster: bool = False,
           callbacks: Optional[Sequence[Callable]] = None,
           fobj=None) -> Booster:
-    """Main training loop (engine.py:109 analog)."""
+    """Main training loop (engine.py:109 analog).
+
+    Eval-cadence contract: callbacks and early stopping observe metrics
+    every ``eval_period`` iterations (config.py; default 1 preserves
+    per-iteration semantics exactly). Between eval points the fused
+    trainer (boosting/gbdt.py) runs dispatch-ahead — one jit dispatch
+    per iteration, zero host syncs — and no-split stop detection rides
+    a device flag checked only at those sync points.
+    """
     params = dict(params or {})
     cfg = Config(params)
     log.set_verbosity(int(cfg.verbosity))
+    enable_compilation_cache()
     if "num_iterations" in cfg.explicit():  # any registered alias resolves
         num_boost_round = cfg.num_iterations
     if callable(params.get("objective")):
@@ -1238,6 +1312,18 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # metric-consumption (callback.py contract): skip metric work no
+    # after-callback will read. Train-set eval additionally requires a
+    # callback that consumes TRAINING entries — early stopping never
+    # does — so is_provide_training_metric with only early stopping
+    # active no longer pays a full train eval per eval point.
+    eval_consumers = [cb for cb in callbacks_after
+                      if getattr(cb, "needs_eval", True)]
+    train_metric_consumers = [
+        cb for cb in callbacks_after
+        if getattr(cb, "consumes_train_metrics", True)]
+    eval_period = max(1, int(cfg.eval_period))
+
     # continued training iterates [init_iteration, init_iteration + rounds)
     # (reference engine.py:309 `range(init_iteration, init_iteration +
     # num_boost_round)`) so best_iteration indexes the FULL ensemble —
@@ -1249,16 +1335,27 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                                  end_iteration, None)
         for cb in callbacks_before:
             cb(env_before)
+        snapshot_here = (cfg.snapshot_freq > 0
+                         and (i + 1) % cfg.snapshot_freq == 0)
+        # sync points: every eval_period-th iteration, the final one,
+        # and snapshot boundaries. Between them the fused trainer
+        # defers — trees stay on device, no host syncs.
+        sync_here = ((i - init_iteration + 1) % eval_period == 0
+                     or i == end_iteration - 1 or snapshot_here)
         # step marker for jax.profiler traces (profiler.trace) — the
         # per-iteration timing hook of gbdt.cpp:246-249
         with profiler.step_annotation("boost_iter", step_num=i):
-            stop = booster.update(fobj=fobj)
+            stop = booster.update(fobj=fobj, defer=not sync_here)
+        if not (sync_here or stop):
+            continue
         evals = []
-        need_eval = bool(callbacks_after) or cfg.early_stopping_round > 0
+        need_eval = bool(eval_consumers) or cfg.early_stopping_round > 0
         if need_eval:
-            if cfg.is_provide_training_metric:
-                evals.extend(booster.eval_train(feval))
-            evals.extend(booster.eval_valid(feval))
+            with profiler.phase("eval"):
+                if cfg.is_provide_training_metric and (
+                        train_metric_consumers or not callbacks_after):
+                    evals.extend(booster.eval_train(feval))
+                evals.extend(booster.eval_valid(feval))
         env = CallbackEnv(booster, params, i, init_iteration, end_iteration,
                           evals)
         try:
@@ -1269,7 +1366,7 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             for name, metric, value, _ in (e.best_score or []):
                 booster.best_score.setdefault(name, {})[metric] = value
             break
-        if cfg.snapshot_freq > 0 and (i + 1) % cfg.snapshot_freq == 0:
+        if snapshot_here:
             # periodic checkpoint (gbdt.cpp:250-254): full model text,
             # resumable via init_model
             booster.save_model(

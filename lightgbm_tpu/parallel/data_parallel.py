@@ -112,6 +112,15 @@ class DataParallelPlan:
         self.num_processes = jax.process_count()
         self.multi_process = self.num_processes > 1
 
+    def supports_fused(self) -> bool:
+        """Whether gbdt's fused single-dispatch step may stage this
+        plan's tree build inside its outer jit. Single-controller
+        meshes compose (the shard_map build nests in the fused trace
+        and the psum stays the only cross-chip traffic); multi-process
+        runs assemble per-host blocks with host-side placement calls
+        between phases, which the fused trace cannot contain."""
+        return not self.multi_process
+
     def pad_to(self, num_rows: int, block: int) -> int:
         """GLOBAL padded row count. ``num_rows`` is this process's local
         row count (they differ across hosts); every process pads its
@@ -245,6 +254,10 @@ class FeatureParallelPlan:
                 "feature_shard_storage is single-host; multi-host "
                 "feature-parallel replicates the full matrix per "
                 "worker (set feature_shard_storage=false)")
+
+    # same single-controller rule as the data plan: the feature-sharded
+    # build (and its winner argmax-merge) nests inside the fused trace
+    supports_fused = DataParallelPlan.supports_fused
 
     def pad_to(self, num_rows: int, block: int) -> int:
         return ((num_rows + block - 1) // block) * block

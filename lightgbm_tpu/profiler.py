@@ -6,10 +6,31 @@ the native tool is the XLA profiler: ``jax.profiler`` traces viewable in
 TensorBoard/Perfetto, with per-iteration step markers emitted by
 engine.train (StepTraceAnnotation).
 
-Usage::
+Workflow::
 
     with lightgbm_tpu.profiler.trace("/tmp/tb"):
         lgb.train(params, ds, 100)
+    # then: tensorboard --logdir /tmp/tb  (Profile tab), or pass
+    # create_perfetto_link=True for a one-shot Perfetto URL.
+
+What the trace attributes, per layer:
+
+- ``boost_iter`` step markers (engine.train) delimit iterations, so the
+  trace viewer's step table gives ms/tree directly.
+- Training phases — ``grads`` / ``sampling`` / ``build`` / ``update`` /
+  ``eval`` — are emitted through :func:`phase` by BOTH training drivers
+  (boosting/gbdt.py):
+
+  * the legacy loop runs one dispatch per phase, so each phase shows up
+    as a host ``TraceAnnotation`` span wrapping its dispatch + wait;
+  * the fused single-dispatch step traces the phases as
+    ``jax.named_scope`` prefixes, so every XLA op inside the one fused
+    program carries its phase in the op name ("grads/...",
+    "build/...") and the trace viewer's op table groups device time by
+    phase even though the host sees a single dispatch.
+
+  Metric evaluation at eval-cadence points is wrapped in the ``eval``
+  phase by engine.train.
 """
 
 from __future__ import annotations
@@ -17,7 +38,7 @@ from __future__ import annotations
 import contextlib
 from typing import Iterator, Optional
 
-__all__ = ["trace", "step_annotation", "annotate"]
+__all__ = ["trace", "step_annotation", "annotate", "phase"]
 
 
 @contextlib.contextmanager
@@ -44,3 +65,15 @@ def annotate(name: str):
     """Named sub-scope inside a step (global_timer sections analog)."""
     import jax
     return jax.profiler.TraceAnnotation(name)
+
+
+@contextlib.contextmanager
+def phase(name: str) -> Iterator[None]:
+    """Training-phase marker usable from BOTH drivers: emits a host
+    ``TraceAnnotation`` span (meaningful around eager dispatches — the
+    legacy loop, engine eval) AND a ``jax.named_scope`` so ops staged
+    inside an ambient trace (the fused step) carry ``name/`` as an op
+    prefix the profiler groups by."""
+    import jax
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield

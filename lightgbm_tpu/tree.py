@@ -141,6 +141,18 @@ class Tree:
             tree.leaf_count[s] = int(cnt[n])
         return tree
 
+    @classmethod
+    def from_device_batch(cls, host_trees, bin_mappers, used_features,
+                          shrinkage: float):
+        """Convert one iteration's K device-built trees (already pulled
+        to host — the fused trainer's sync() fetches the whole pending
+        ring in ONE device transfer, then decodes here) into ``Tree``
+        models. The per-tree decode is host-only numpy; keeping it out
+        of the training inner loop is what lets the fused step run
+        sync-free between eval points."""
+        return [cls.from_device(t, bin_mappers, used_features, shrinkage)
+                for t in host_trees]
+
     def _append_cat_bitset(self, categories: List[int]):
         """Append one categorical split's bitset (tree.cpp cat storage)."""
         maxc = max(categories)

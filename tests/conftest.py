@@ -29,6 +29,18 @@ _isa = _isa.group(1).lower() if _isa else "hostisa"
 # force CPU: the session env pins JAX_PLATFORMS to the TPU tunnel platform,
 # and the env var alone does not win against it — use the config API.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Suite default: pin the LEGACY training driver. The fused
+# single-dispatch step (ISSUE 3) jit-closes over each booster's device
+# data, so it compiles one program PER BOOSTER — correct, and the right
+# trade on real workloads (hundreds of iterations amortize one
+# compile), but this suite constructs hundreds of tiny boosters and on
+# the 1-core CI host those per-booster compiles roughly double suite
+# wall-clock, past the tier-1 budget. The legacy driver shares its
+# module-level build_tree jit across boosters. Fused coverage is
+# concentrated in tests/test_fused_train.py, which opts back in
+# per-train (parity across configs, eval cadence, deferred stop flag,
+# mesh nesting).
+os.environ.setdefault("LIGHTGBM_TPU_FUSED_TRAIN", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
