@@ -604,6 +604,86 @@ def fused_bench(ds, dsv, params, iters: int) -> dict:
     return out
 
 
+def dp_comm_bench() -> dict:
+    """Histogram merge-mode ablation on the 8-virtual-device mesh
+    (ISSUE 4): the same data-parallel training run under
+    dp_hist_merge=allreduce vs reduce_scatter — ms_per_tree for both,
+    plus the per-chip histogram-collective bytes per tree from the
+    static auditor (parallel/comms). Subprocess-isolated: the
+    virtual-device XLA flag must be set before jax initializes, and the
+    main bench process owns the real backend. BENCH_DP_COMM=0 skips."""
+    import subprocess
+    import tempfile
+    rows = int(os.environ.get("BENCH_DP_COMM_ROWS", 1 << 16))
+    iters = int(os.environ.get("BENCH_DP_COMM_ITERS", 8))
+    script = f"""
+import json, time
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel import comms
+from lightgbm_tpu.parallel.data_parallel import DataParallelPlan
+
+rng = np.random.RandomState(0)
+R, F, L, W = {rows}, 24, 63, 8
+X = rng.normal(size=(R, F)).astype(np.float32)
+y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+out = {{"dp_comm_rows": R, "dp_comm_iters": {iters},
+       "dp_comm_devices": 8}}
+preds = {{}}
+for hm in ("allreduce", "reduce_scatter"):
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(dict(objective="binary", num_leaves=L,
+                         leaf_batch=W, min_data_in_leaf=20,
+                         verbosity=-1, tree_learner="data",
+                         dp_hist_merge=hm), ds, num_boost_round=2)
+    t0 = time.time()
+    for _ in range({iters}):
+        bst.update()
+    bst._gbdt.scores.block_until_ready()
+    out[f"dp_merge_ms_per_tree_{{hm}}"] = round(
+        (time.time() - t0) / {iters} * 1e3, 2)
+    preds[hm] = bst.predict(X[:4096])
+    rep = comms.audit_tree_program(
+        DataParallelPlan(hist_merge=hm), R=1024, F=F, B=255,
+        num_leaves=L, leaf_batch=W, hist_dtype="bfloat16")
+    out[f"dp_hist_bytes_per_round_{{hm}}"] = rep.hist_result_bytes
+    out[f"dp_comm_bytes_per_tree_{{hm}}"] = comms.hist_bytes_per_tree(
+        rep, L, W)
+out["dp_comm_bytes_per_tree"] = out[
+    "dp_comm_bytes_per_tree_reduce_scatter"]
+out["dp_hist_bytes_ratio"] = round(
+    out["dp_comm_bytes_per_tree_reduce_scatter"]
+    / max(1, out["dp_comm_bytes_per_tree_allreduce"]), 4)
+out["dp_merge_bit_identical"] = bool(
+    np.array_equal(preds["allreduce"], preds["reduce_scatter"]))
+print("DPCOMM=" + json.dumps(out))
+"""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ,
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8"
+                          ).strip(),
+               JAX_PLATFORMS="cpu", LIGHTGBM_TPU_FUSED_TRAIN="0",
+               PYTHONPATH=(here + os.pathsep
+                           + os.environ.get("PYTHONPATH", "")))
+    with tempfile.NamedTemporaryFile("w", suffix=".py",
+                                     delete=False) as f:
+        f.write(script)
+        path = f.name
+    try:
+        r = subprocess.run([sys.executable, path], cwd=here, env=env,
+                           capture_output=True, text=True, timeout=900)
+        for ln in r.stdout.splitlines():
+            if ln.startswith("DPCOMM="):
+                return json.loads(ln.split("=", 1)[1])
+        return {"dp_comm_error":
+                (r.stderr or "no output").strip()[-300:]}
+    except subprocess.TimeoutExpired:
+        return {"dp_comm_error": "timeout"}
+    finally:
+        os.unlink(path)
+
+
 def compile_cache_probe() -> dict:
     """Cold vs warm compile+warmup seconds through the persistent XLA
     compilation cache (engine.enable_compilation_cache): the identical
@@ -915,6 +995,14 @@ def main():
         except Exception as e:  # noqa: BLE001 — probes never kill bench
             print(f"fused bench failed: {e}", file=sys.stderr)
 
+    dp_fields = {}
+    if os.environ.get("BENCH_DP_COMM", "1") != "0":
+        try:
+            dp_fields = dp_comm_bench()
+            print(f"dp comm ablation: {dp_fields}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"dp comm ablation failed: {e}", file=sys.stderr)
+
     cc_fields = {}
     if os.environ.get("BENCH_COMPILE_CACHE", "1") != "0":
         try:
@@ -953,6 +1041,7 @@ def main():
         **pred_fields,
         **lb_fields,
         **fused_fields,
+        **dp_fields,
         **cc_fields,
         **serve_fields,
         **ref_fields,

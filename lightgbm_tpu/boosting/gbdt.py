@@ -219,6 +219,19 @@ class GBDT:
                 from .. import log as _log
                 _log.warning("feature_shard_storage only applies with "
                              "tree_learner=feature; ignoring")
+            if plan_cls is not FeatureParallelPlan:
+                hm = str(config.dp_hist_merge)
+                if config.forcedsplits_filename and hm != "allreduce":
+                    # the forced-split gather reads full-feature
+                    # histogram rows from the per-leaf cache, which the
+                    # scattered layout shards by feature slot
+                    from .. import log as _log
+                    if hm == "reduce_scatter":
+                        _log.warning(
+                            "forced splits need the full-histogram "
+                            "merge; pinning dp_hist_merge=allreduce")
+                    hm = "allreduce"
+                plan_kw["hist_merge"] = hm
             self.plan = plan_cls(top_k=int(config.top_k), **plan_kw)
             if (plan_cls is FeatureParallelPlan
                     and getattr(self.plan, "multi_process", False)):
@@ -266,6 +279,15 @@ class GBDT:
         # lattice, or F*B after the feature-mode unbundle above)
         _lattice = (self._bundle_bins * bp.num_bundles
                     if self._bundle_meta is not None else F * self.B)
+        # reduce-scatter data-parallel slot-shards the per-leaf raw
+        # cache by feature slot (and stores it in UNBUNDLED feature
+        # space): each chip budgets 1/n of the feature lattice
+        self._dp_rs = bool(
+            self.plan is not None and self.plan.parallel_mode == "data"
+            and getattr(self.plan, "hist_merge", "") == "reduce_scatter"
+            and self.plan.num_shards > 1)
+        if self._dp_rs:
+            _lattice = -(-(F * self.B) // self.plan.num_shards)
         self._hist_sub = _hist_sub_gate(-(-_lattice // n_fs))
         # capacity gate BEFORE the device transfer (VERDICT r4 #5):
         # fail with sized guidance, not a mid-training device OOM

@@ -75,7 +75,7 @@ from ..native import jax_ffi as _jax_ffi
 import numpy as np
 
 from ..ops.histogram import (build_histograms, resolve_impl, HIST_CH,
-                             _pvary)
+                             merge_histograms, _pvary)
 from ..ops.predict import row_feature_gather
 from ..ops.split import (SplitParams, find_best_splits, leaf_gain,
                          leaf_output)
@@ -164,14 +164,30 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                forced: Optional[Tuple] = None,
                hist_sub: bool = True,
                bins_cm: Optional[jax.Array] = None,
-               feature_sharded: bool = False):
+               feature_sharded: bool = False,
+               hist_merge: str = "allreduce",
+               n_shards: int = 1):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
     strategy, mirroring tree_learner=data/feature/voting
     (tree_learner.cpp:15 factory):
-    - "data": rows sharded; the histogram psum IS the ReduceScatter
-      merge; split selection replicated (no winner sync needed).
+    - "data": rows sharded. ``hist_merge`` picks the merge collective:
+      * "allreduce" (psum): every chip receives the FULL merged
+        histogram and split selection runs replicated (no winner sync
+        needed — the original formulation, ~2x reduce-scatter's wire
+        bytes and n-redundant split work);
+      * "reduce_scatter" (``lax.psum_scatter`` along the feature axis,
+        ``n_shards`` static): each chip receives only its F_pad/n
+        feature-slot block — the reference's TRUE
+        ``Network::ReduceScatter`` per-worker feature-block merge
+        (data_parallel_tree_learner.cpp:284). Split finding runs on the
+        local block only and winners merge SplitInfo-sized via
+        ``_sync_best`` (SyncUpGlobalBestSplit). The per-leaf histogram
+        cache is slot-sharded the same way, cutting its HBM footprint
+        by n. EFB composes by unbundling the LOCAL histogram to feature
+        space first (unbundling is linear, so it commutes with the
+        scatter-sum); the cache then lives in scattered feature space.
     - "feature": rows replicated, split WORK feature-sharded
       (feature_parallel_tree_learner.cpp:38-77): each chip histograms
       only its ``local_bins`` [R, F_loc] slice (``local_meta`` = that
@@ -243,7 +259,12 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         G = bins.shape[1]
 
         def unbundle(hg):
+            # dtype-generic (f32 AND raw int32 quantized): every op here
+            # is LINEAR in the histogram, so unbundling commutes with
+            # cross-shard summation — the reduce-scatter merge unbundles
+            # the LOCAL histogram first and scatters in feature space
             S = hg.shape[0]
+            zero = jnp.zeros((), hg.dtype)
             hflat = hg.reshape(S, G * bundle_bins, HIST_CH)
             idx = (b_gof[:, None] * bundle_bins + b_off[:, None]
                    + jnp.arange(B, dtype=jnp.int32)[None, :])    # [F, B]
@@ -252,12 +273,13 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             idx = jnp.clip(idx, 0, G * bundle_bins - 1)
             hf = jnp.take(hflat, idx.reshape(-1), axis=1).reshape(
                 S, F, B, HIST_CH)
-            hf = jnp.where(bvalid[None, :, :, None], hf, 0.0)
+            hf = jnp.where(bvalid[None, :, :, None], hf, zero)
             totals = hg[:, 0, :, :].sum(axis=1)                  # [S, 3]
             mfb_oh = (jnp.arange(B, dtype=jnp.int32)[None, :]
                       == b_mfb[:, None])                         # [F, B]
             sum_all = hf.sum(axis=2)
-            at_mfb = (hf * mfb_oh[None, :, :, None]).sum(axis=2)
+            at_mfb = jnp.where(mfb_oh[None, :, :, None], hf,
+                               zero).sum(axis=2)
             mfb_val = totals[:, None, :] - (sum_all - at_mfb)
             return jnp.where((mfb_oh & bvalid)[None, :, :, None],
                              mfb_val[:, :, None, :], hf)
@@ -351,6 +373,84 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                 "the serial tree learner too)")
 
     mode = parallel_mode if axis_name is not None else "data"
+    # reduce-scatter merge layouts (ISSUE 4): only meaningful on a mesh
+    rs = (axis_name is not None and hist_merge == "reduce_scatter"
+          and n_shards > 1)
+    rs_data = rs and mode == "data"       # main hist feature-slot-sharded
+    rs_vote = rs and mode == "voting"     # elected columns slot-sharded
+    if rs_data and use_forced:
+        # the forced-split gather reads a full-F histogram row from the
+        # cache; callers (gbdt) route forced splits to allreduce
+        raise ValueError(
+            "forced splits need hist_merge=allreduce under "
+            "tree_learner=data (full-feature histogram gather)")
+    if rs_data and not use_bundle:
+        # feature-slot shard geometry: F padded so it splits evenly;
+        # pad features are trivial (1 bin, masked out), never selected
+        F_pad_rs = -(-F // n_shards) * n_shards
+        F_loc_rs = F_pad_rs // n_shards
+        pf_rs = F_pad_rs - F
+        nb_rs = jnp.pad(num_bins_pf, (0, pf_rs), constant_values=1)
+        nan_rs = jnp.pad(nan_bin_pf, (0, pf_rs), constant_values=-1)
+        cat_rs = jnp.pad(is_cat_pf, (0, pf_rs))
+        mono_rs = (jnp.pad(mono_type_pf, (0, pf_rs))
+                   if mono_type_pf is not None else None)
+        csm_rs = (jnp.pad(cat_sorted_mask, (0, pf_rs))
+                  if cat_sorted_mask is not None else None)
+    elif rs_data:
+        # EFB: the scatter slots along the BUNDLE axis (the storage
+        # lattice the histogram is built in). Scattering unbundled
+        # feature space instead would NOT be bit-stable: the
+        # most-frequent-bin reconstruction (totals - sum of others) is
+        # linear but reassociates under per-shard unbundling, and its
+        # cancellation noise can flip near-tie splits. In bundle space
+        # the scatter is elementwise-identical to the psum, each chip
+        # owns whole bundles (= whole features; a feature never spans
+        # bundles), and the cache stays raw/exact. Chips own
+        # G_pad/n bundle columns; split finding masks to owned features.
+        G_pad_rs = -(-G // n_shards) * n_shards
+        G_loc_rs = G_pad_rs // n_shards
+
+        def unbundle_shard(hg):
+            """unbundle for this chip's [S, G_loc, bb, CH] scattered
+            block of the MERGED bundle-space histogram -> [S, F, B, CH]
+            feature space, zero outside the owned-feature set. Leaf
+            totals (the mfb-reconstruction minuend) are computed by
+            bundle 0's owner exactly as the replicated unbundle does —
+            sum over the merged column's bins — and broadcast with a
+            single-contributor psum, so every reconstructed value is
+            bit-identical to the allreduce path's."""
+            S = hg.shape[0]
+            zero = jnp.zeros((), hg.dtype)
+            gl0 = jax.lax.axis_index(axis_name) * jnp.int32(G_loc_rs)
+            own = (b_gof >= gl0) & (b_gof < gl0 + G_loc_rs)      # [F]
+            hflat = hg.reshape(S, G_loc_rs * bundle_bins, HIST_CH)
+            gof_loc = jnp.clip(b_gof - gl0, 0, G_loc_rs - 1)
+            idx = (gof_loc[:, None] * bundle_bins + b_off[:, None]
+                   + jnp.arange(B, dtype=jnp.int32)[None, :])    # [F, B]
+            bvalid = ((jnp.arange(B, dtype=jnp.int32)[None, :]
+                       < num_bins_pf[:, None]) & own[:, None])
+            idx = jnp.clip(idx, 0, G_loc_rs * bundle_bins - 1)
+            hf = jnp.take(hflat, idx.reshape(-1), axis=1).reshape(
+                S, F, B, HIST_CH)
+            hf = jnp.where(bvalid[None, :, :, None], hf, zero)
+            tot_loc = jnp.where(
+                gl0 == 0, hg[:, 0, :, :].sum(axis=1),
+                jnp.zeros((S, HIST_CH), hg.dtype))
+            totals = jax.lax.psum(tot_loc, axis_name)            # [S, 3]
+            mfb_oh = (jnp.arange(B, dtype=jnp.int32)[None, :]
+                      == b_mfb[:, None])                         # [F, B]
+            sum_all = hf.sum(axis=2)
+            at_mfb = jnp.where(mfb_oh[None, :, :, None], hf,
+                               zero).sum(axis=2)
+            mfb_val = totals[:, None, :] - (sum_all - at_mfb)
+            return jnp.where((mfb_oh & bvalid)[None, :, :, None],
+                             mfb_val[:, :, None, :], hf)
+
+        def rs_own_mask():
+            """[F] bool — features whose bundle this chip owns."""
+            gl0 = jax.lax.axis_index(axis_name) * jnp.int32(G_loc_rs)
+            return (b_gof >= gl0) & (b_gof < gl0 + G_loc_rs)
     if use_bundle and mode == "feature":
         # internal invariant, not a user-facing limit: GBDT decodes the
         # bundled matrix to feature space before entering this mode
@@ -401,7 +501,9 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         if axis_name is not None:
             h = _pvary(h, axis_name)
             if merge:
-                h = jax.lax.psum(h, axis_name)
+                h = merge_histograms(
+                    h, axis_name,
+                    "reduce_scatter" if rs_data else True, n_shards)
         return h
 
     def hist_raw_for(slots, rl, gh_in=None, row_gather=None, num_rows=None,
@@ -415,28 +517,51 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
           feature later). EFB composes: unbundling locally commutes with
           the later psum of elected columns — votes and elections run in
           feature space, communication stays O(top_k * B);
-        - data/serial: [S, F|G, B|bb, 3], psum-merged over axis_name."""
+        - data/serial, hist_merge=allreduce: [S, F|G, B|bb, 3],
+          psum-merged over axis_name (replicated);
+        - data, hist_merge=reduce_scatter: [S, (F|G)_pad/n, B|bb, 3] —
+          this chip's slot block of the merged histogram, scattered
+          along the STORAGE lattice's feature axis (bundle columns when
+          EFB is on: a feature never spans bundles, so whole features
+          stay chip-local and the raw cache stays exact)."""
         if use_native_part and part is not None:
             return hist_perm_for(slots, part, gh_in=gh_in)
         mat = local_bins if mode == "feature" else bins
         nb_in = bundle_bins if use_bundle else B
-        merge = mode not in ("feature", "voting")
+        if mode in ("feature", "voting"):
+            merge = False
+        elif rs_data:
+            merge = "reduce_scatter"
+        else:
+            merge = True
         return build_histograms(
             mat, gh if gh_in is None else gh_in, rl, slots,
             num_bins=nb_in, block_rows=block_rows, axis_name=axis_name,
-            merge=merge, hist_dtype=hist_dtype, impl=hist_impl,
-            row_gather=row_gather, num_rows=num_rows)
+            merge=merge, n_shards=n_shards, hist_dtype=hist_dtype,
+            impl=hist_impl, row_gather=row_gather, num_rows=num_rows)
 
     def hist_finish(hraw):
-        """Raw -> per-feature f32 split-finding space."""
+        """Raw -> per-feature f32 split-finding space. The scattered
+        EFB layout unbundles this chip's bundle block (zeros outside
+        the owned-feature set — split finding masks to owned)."""
         h = _dequant(hraw)
-        return unbundle(h) if use_bundle else h
+        if not use_bundle:
+            return h
+        return unbundle_shard(h) if rs_data else unbundle(h)
 
     def hist_for(slots, rl, part=None):
         return hist_finish(hist_raw_for(slots, rl, part=part))
 
     def _sync_best(bs):
-        """Merge per-shard best splits by gain (SyncUpGlobalBestSplit)."""
+        """Merge per-shard best splits by gain (SyncUpGlobalBestSplit).
+        SplitInfo-sized (a handful of [S]-shaped collectives) — tagged
+        ``winner_sync`` so the collective auditor (parallel/comms.py)
+        separates it from histogram traffic."""
+        from .. import profiler
+        with profiler.phase("winner_sync"):
+            return _sync_best_impl(bs)
+
+    def _sync_best_impl(bs):
         gain = bs["gain"]
         gmax = jax.lax.pmax(gain, axis_name)
         idx = jax.lax.axis_index(axis_name)
@@ -684,13 +809,38 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             # 3. elect global top-2k (ties -> lower feature id)
             score = votes * (F + 1.0) - jnp.arange(F, dtype=f32)[None, :]
             _, elected = jax.lax.top_k(score, k2)             # [S, k2]
-            # 4. merge ONLY the elected columns across chips
-            sub_hist = jax.lax.psum(
-                jnp.take_along_axis(
-                    hist2w, elected[:, :, None, None], axis=1), axis_name)
+            # 4. merge ONLY the elected columns across chips. With
+            # hist_merge=reduce_scatter the merge lands slot-SHARDED
+            # (each chip receives its k2_pad/n elected-column block,
+            # searches it, and the winner syncs SplitInfo-sized) —
+            # closing the replicated-psum TODO of data_parallel.py:
+            # wire bytes halve and the sub-split search stops being
+            # n-redundant. Elections are replicated (votes psum'd), so
+            # every chip slices consistently.
+            sub_loc = jnp.take_along_axis(
+                hist2w, elected[:, :, None, None], axis=1)    # [S,k2,...]
+            if rs_vote:
+                k2p = -(-k2 // n_shards) * n_shards
+                k2_loc = k2p // n_shards
+                pe = k2p - k2
+                sub_hist = merge_histograms(
+                    sub_loc, axis_name, "reduce_scatter", n_shards)
+                off_v = (jax.lax.axis_index(axis_name)
+                         * jnp.int32(k2_loc))
+                # pad lane -> elected feature 0 with its mask forced
+                # False (its scattered histogram block is zero anyway)
+                elected = jax.lax.dynamic_slice(
+                    jnp.pad(elected, ((0, 0), (0, pe))),
+                    (jnp.int32(0), off_v), (S, k2_loc))
+                lane_ok = jax.lax.dynamic_slice(
+                    jnp.arange(k2p, dtype=jnp.int32) < k2,
+                    (off_v,), (k2_loc,))[None, :]
+            else:
+                sub_hist = merge_histograms(sub_loc, axis_name, True)
+                lane_ok = True
             sub_fmask = (jnp.take_along_axis(fmask_s, elected, axis=1)
                          if fmask_s.ndim == 2
-                         else jnp.take(fmask_s, elected))
+                         else jnp.take(fmask_s, elected)) & lane_ok
             bs = find_best_splits(
                 sub_hist, jnp.take(num_bins_pf, elected),
                 jnp.take(nan_bin_pf, elected),
@@ -716,6 +866,56 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             bs["feature"] = jnp.take_along_axis(
                 elected, bs["feature"][:, None], axis=1)[:, 0] \
                 .astype(jnp.int32)
+        elif rs_data and use_bundle:
+            # scattered EFB shard: hist2w is already unbundled to FULL
+            # feature space, zero outside this chip's owned-bundle
+            # features — search all F columns with the ownership mask
+            # (communication is the scattered bundle block; the search
+            # itself is not divided because bundle->feature ownership
+            # is not a contiguous slice), then merge winners.
+            bs = find_best_splits(
+                hist2w, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
+                feature_mask=fmask_s & rs_own_mask()[None, :],
+                mono_type=mono_type_pf,
+                leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
+                slot_depth=slot_depth, rand_bin=rand_bin,
+                cat_sorted_mask=cat_sorted_mask, adv_bounds=adv)
+        elif rs_data:
+            # scattered-shard split search (mode == "data",
+            # hist_merge=reduce_scatter): hist2w is this chip's
+            # [S, F_loc, B, 3] feature-slot block of the MERGED
+            # histogram. Constraint masks and PRNG are replicated, so
+            # the global [S, F] candidate mask is computed identically
+            # everywhere and sliced at this chip's window — the same
+            # composition rule the feature-parallel branch uses.
+            S = slots_c.shape[0]
+            off = jax.lax.axis_index(axis_name) * jnp.int32(F_loc_rs)
+            z32 = jnp.int32(0)
+
+            def _slice1(a):
+                return jax.lax.dynamic_slice(a, (off,), (F_loc_rs,))
+
+            def _slice2(a):
+                return jax.lax.dynamic_slice(
+                    jnp.pad(a, ((0, 0), (0, pf_rs))), (z32, off),
+                    (S, F_loc_rs))
+            bs = find_best_splits(
+                hist2w, _slice1(nb_rs), _slice1(nan_rs),
+                _slice1(cat_rs), sp,
+                feature_mask=_slice2(fmask_s),
+                mono_type=(_slice1(mono_rs) if use_mono else None),
+                leaf_lo=lo, leaf_hi=hi, parent_output=parent_out,
+                slot_depth=slot_depth,
+                rand_bin=(_slice2(rand_bin)
+                          if rand_bin is not None else None),
+                cat_sorted_mask=(_slice1(csm_rs)
+                                 if cat_sorted_mask is not None
+                                 else None),
+                adv_bounds=(tuple(jax.lax.dynamic_slice(
+                    jnp.pad(a, ((0, 0), (0, pf_rs), (0, 0))),
+                    (z32, off, z32), (S, F_loc_rs, a.shape[2]))
+                    for a in adv) if adv is not None else None))
+            bs["feature"] = bs["feature"] + off
         else:
             bs = find_best_splits(
                 hist2w, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
@@ -730,7 +930,9 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             g = jnp.where(slot_depth < max_depth, g, NEG_INF)
         g = jnp.where(slot_valid, g, NEG_INF)
         bs["gain"] = g
-        if mode == "feature":
+        if mode == "feature" or rs_data or rs_vote:
+            # feature-sharded search (by plan, or by the scattered
+            # histogram layout): merge winners SplitInfo-sized
             bs = _sync_best(bs)
         return bs
 
@@ -828,6 +1030,19 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         # local hist -> global root sums (the Allreduce of root
         # (count, sum_g, sum_h), data_parallel_tree_learner.cpp:160-219)
         root_sums = jax.lax.psum(root_sums, axis_name)
+    elif rs_data:
+        # scattered layout: exactly ONE chip holds global feature 0's
+        # merged column (chip 0 in the plain layout; the owner of
+        # bundle b_gof[0] under EFB — hist0 is zero elsewhere), and its
+        # bin sum is the global root totals. One [3]-sized psum
+        # broadcasts the owner's value.
+        if use_bundle:
+            own0 = rs_own_mask()[0]
+        else:
+            own0 = jax.lax.axis_index(axis_name) == 0
+        root_sums = jax.lax.psum(
+            jnp.where(own0, root_sums, jnp.zeros_like(root_sums)),
+            axis_name)
     root_val = leaf_output(root_sums[0], root_sums[1], sp.lambda_l1,
                            sp.lambda_l2, sp.max_delta_step)
     tree = tree._replace(
@@ -1423,5 +1638,6 @@ _build_tree_jit = functools.partial(
                      "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins", "mono_method",
-                     "forced", "hist_sub", "feature_sharded"))(
+                     "forced", "hist_sub", "feature_sharded",
+                     "hist_merge", "n_shards"))(
     _build_tree_impl)

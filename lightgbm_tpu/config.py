@@ -184,6 +184,19 @@ PARAMS: Dict[str, ParamSpec] = {
                "evaluation; larger values let the fused trainer run "
                "dispatch-ahead with zero host syncs between eval "
                "points"),
+        _p("dp_hist_merge", "auto", str,
+           check=lambda v: v in ("auto", "allreduce", "reduce_scatter"),
+           doc="histogram merge collective for tree_learner=data/voting "
+               "on a multi-chip mesh: reduce_scatter (each chip "
+               "receives only its F/n feature-slot block of the merged "
+               "histogram, finds its local best split, and winners sync "
+               "SplitInfo-sized — the reference Network::ReduceScatter "
+               "algorithm; ~2x less wire traffic and 1/n the per-chip "
+               "histogram HBM of allreduce), allreduce (full-histogram "
+               "psum, replicated split finding — the ablation "
+               "baseline), or auto (reduce_scatter when the mesh has "
+               ">1 device). LIGHTGBM_TPU_DP_HIST_MERGE overrides from "
+               "the env; forced splits pin allreduce"),
         _p("leaf_batch", 16, int,
            doc="Leaves split per on-device round; 1 = exact best-first"
                " (reference semantics), >1 batches frontier growth to keep the"

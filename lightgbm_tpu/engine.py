@@ -1246,6 +1246,17 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     trainer (boosting/gbdt.py) runs dispatch-ahead — one jit dispatch
     per iteration, zero host syncs — and no-split stop detection rides
     a device flag checked only at those sync points.
+
+    Multi-chip merge contract: with ``tree_learner=data/voting`` on a
+    multi-device mesh the per-round histogram merge defaults to the
+    feature-slot reduce-scatter (``dp_hist_merge=auto``; see
+    parallel/data_parallel.py). The scattered build nests inside the
+    fused single-dispatch trace unchanged — the plan's shard_map
+    program, its ``lax.psum_scatter`` and its SplitInfo winner sync are
+    all staged into the one jitted iteration, so dispatch-ahead and the
+    halved histogram traffic compose. ``dp_hist_merge=allreduce`` (or
+    ``LIGHTGBM_TPU_DP_HIST_MERGE=allreduce``) pins the replicated-psum
+    baseline; results are bit-identical either way.
     """
     params = dict(params or {})
     cfg = Config(params)

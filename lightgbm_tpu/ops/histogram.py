@@ -39,10 +39,52 @@ import jax.numpy as jnp
 from ..native import jax_ffi as _jax_ffi
 import numpy as np
 
-__all__ = ["build_histograms", "resolve_impl", "HIST_CH"]
+__all__ = ["build_histograms", "resolve_impl", "merge_histograms",
+           "HIST_CH"]
 
 # channels per histogram cell: (sum_grad, sum_hess, count)
 HIST_CH = 3
+
+
+def merge_histograms(hist: jax.Array, axis_name: Optional[str],
+                     merge="allreduce", n_shards: int = 1) -> jax.Array:
+    """Cross-shard merge of a ``[L, F, B, CH]`` histogram — the
+    ``Network::ReduceScatter`` analog (data_parallel_tree_learner.cpp:284),
+    factored out so every kernel path (matmul/scatter/native/pallas) and
+    the tree builder's EFB-unbundled merge share ONE implementation.
+
+    ``merge`` selects the collective:
+    - ``False`` / ``"none"``: no collective — the histogram stays
+      shard-local (feature/voting-parallel merge selectively later).
+    - ``True`` / ``"allreduce"``: ``lax.psum`` — every shard receives the
+      full merged histogram (replicated split finding; ~2x the wire
+      bytes of reduce-scatter and n-redundant downstream work).
+    - ``"reduce_scatter"``: ``lax.psum_scatter`` along the feature axis
+      (dim 1, padded to a multiple of ``n_shards``): shard k receives
+      ONLY its ``F_pad/n`` feature-slot block ``[k*F_pad/n, (k+1)*F_pad/n)``
+      of the merged histogram — the reference's true per-worker
+      feature-block merge. Split finding then runs on the local block
+      and winners sync SplitInfo-sized (see tree_builder._sync_best).
+
+    The collective is wrapped in the ``hist_merge`` profiler phase, so
+    trace viewers group its device time and the collective-traffic
+    auditor (parallel/comms.py) can attribute histogram collectives by
+    the ``hist_merge`` op-name prefix.
+    """
+    if axis_name is None or merge in (False, "none", None):
+        return hist
+    from .. import profiler
+    with profiler.phase("hist_merge"):
+        if merge == "reduce_scatter":
+            F = hist.shape[1]
+            F_pad = -(-F // n_shards) * n_shards
+            if F_pad != F:
+                cfg = [(0, 0)] * hist.ndim
+                cfg[1] = (0, F_pad - F)
+                hist = jnp.pad(hist, cfg)
+            return jax.lax.psum_scatter(hist, axis_name,
+                                        scatter_dimension=1, tiled=True)
+        return jax.lax.psum(hist, axis_name)
 
 
 def _pick_block_rows(num_rows: int, fb: int, dtype_bytes: int = 2,
@@ -174,12 +216,13 @@ def resolve_impl(impl: str) -> str:
 @functools.partial(
     jax.jit,
     static_argnames=("num_bins", "block_rows", "axis_name", "hist_dtype",
-                     "impl", "merge"))
+                     "impl", "merge", "n_shards"))
 def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                      leaf_ids: jax.Array, *, num_bins: int,
                      block_rows: int = 0, axis_name: Optional[str] = None,
                      hist_dtype: str = "bfloat16",
-                     impl: str = "auto", merge: bool = True,
+                     impl: str = "auto", merge=True,
+                     n_shards: int = 1,
                      row_gather: Optional[jax.Array] = None,
                      num_rows: Optional[jax.Array] = None) -> jax.Array:
     """Accumulate per-(leaf, feature, bin) sums of (grad, hess, count).
@@ -193,10 +236,14 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         sentinel (-2) for unused slots — matches nothing.
       num_bins: static B (max bins over features).
       axis_name: if inside shard_map over a row-sharded mesh axis, the
-        mapped axis name; histograms are psum-merged over it — the analog of
-        the reference's ReduceScatter+Allgather histogram merge
-        (data_parallel_tree_learner.cpp:284). With ``merge=False`` the
-        result stays shard-LOCAL (feature/voting-parallel modes merge
+        mapped axis name; histograms are merged over it per ``merge``
+        (see :func:`merge_histograms`) — ``True``/``"allreduce"`` is the
+        replicated psum, ``"reduce_scatter"`` the feature-slot-scattered
+        ``lax.psum_scatter`` (the reference's true
+        ``Network::ReduceScatter`` per-worker feature-block merge,
+        data_parallel_tree_learner.cpp:284; result is ``[L, F_pad/n, B,
+        CH]`` with ``n = n_shards``). With ``merge=False`` the result
+        stays shard-LOCAL (feature/voting-parallel modes merge
         selectively later) but scan carries are still marked varying.
       impl: "matmul" (MXU one-hot formulation), "scatter" (XLA
         scatter-add), "native" (the C kernel as an XLA FFI custom call
@@ -259,9 +306,11 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         hist = build_histograms_pallas(
             bins_p, gh, row_leaf, leaf_ids, num_bins=B,
             hist_dtype=hist_dtype, num_rows=num_rows)
-        if axis_name is not None:
-            hist = jax.lax.psum(hist, axis_name)
-        return hist
+        # honor merge=False: feature-parallel slots are feature-disjoint
+        # and voting merges elected columns itself — an unconditional
+        # psum here was a pure-waste no-op for the former and would
+        # double-count for the latter
+        return merge_histograms(hist, axis_name, merge, n_shards)
 
     if impl == "native":
         # the C kernel as an XLA FFI custom call (CPU backend): one
@@ -295,8 +344,7 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                 # custom-call results come back unvarying; restore the
                 # manual-axis type before the merge / loop carry
                 hist = _pvary(hist, axis_name)
-                if merge:
-                    hist = jax.lax.psum(hist, axis_name)
+                hist = merge_histograms(hist, axis_name, merge, n_shards)
             return hist
 
     # quantized addend/accumulator dtypes: int8 operands, exact int32 sums
@@ -357,9 +405,7 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                  gh.reshape(nb, block_rows, HIST_CH),
                  row_leaf.reshape(nb, block_rows)))
         hist = acc[:L * F * B].reshape(L, F, B, HIST_CH)
-        if axis_name is not None and merge:
-            hist = jax.lax.psum(hist, axis_name)
-        return hist
+        return merge_histograms(hist, axis_name, merge, n_shards)
 
     def accum(acc, bb, ghb, lb):
         onehot = (bb.astype(jnp.int32)[:, :, None] == iota_b).astype(adt)
@@ -390,11 +436,10 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
              gh.reshape(nb, block_rows, HIST_CH),
              row_leaf.reshape(nb, block_rows)))
     hist = acc.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
-    if axis_name is not None and merge:
-        # cross-chip merge over ICI — replaces Network::ReduceScatter +
-        # best-split Allgather of the reference data-parallel learner.
-        hist = jax.lax.psum(hist, axis_name)
-    return hist
+    # cross-chip merge over ICI — Network::ReduceScatter analog; with
+    # merge="reduce_scatter" this IS a reduce-scatter and each chip
+    # keeps only its feature-slot block.
+    return merge_histograms(hist, axis_name, merge, n_shards)
 
 
 def build_histograms_reference(bins: np.ndarray, gh: np.ndarray,

@@ -10,21 +10,42 @@ TPU-native analog of the reference distributed tree learners
   ``Allreduce(max-gain)`` (``SyncUpGlobalBestSplit``,
   ``parallel_tree_learner.h:209``).
 - Here the row shard lives on each chip of a ``jax.sharding.Mesh`` axis
-  (ICI within a slice, DCN across hosts) and the whole merge collapses into
-  one ``jax.lax.psum`` of the histogram inside ``ops/histogram.py``. After
-  the psum the histogram is replicated, so every chip runs the *same*
-  split selection and produces the *same* tree — a deterministic replicated
-  argmax needs no winner sync at all. The only cross-chip traffic per round
-  is the histogram reduction, exactly the reference's dominant payload.
+  (ICI within a slice, DCN across hosts). The histogram merge is
+  selectable via ``hist_merge`` (``dp_hist_merge`` param /
+  ``LIGHTGBM_TPU_DP_HIST_MERGE`` env):
+
+  * ``reduce_scatter`` (the default on any multi-chip mesh): the
+    reference's TRUE algorithm — ``jax.lax.psum_scatter`` along the
+    feature-slot axis hands each chip only its F_pad/n block of the
+    merged histogram, ``best_for`` split finding runs on the local
+    block only, and winners merge with the SplitInfo-sized pmax/psum
+    pair feature-parallel already uses (``SyncUpGlobalBestSplit``).
+    Per-round wire bytes halve vs allreduce ((n-1)/n x payload instead
+    of 2(n-1)/n), each chip materializes 1/n of the histogram, the
+    per-leaf histogram-subtraction cache is slot-sharded (HBM/n), and
+    split finding stops being n-redundant — the PV-Tree/DCN bottleneck
+    economics (PAPERS.md: arxiv 1611.01276, 1806.11248).
+  * ``allreduce``: one ``jax.lax.psum`` of the full histogram inside
+    ``ops/histogram.py``. After the psum the histogram is replicated, so
+    every chip runs the *same* split selection and produces the *same*
+    tree — a deterministic replicated argmax needs no winner sync at
+    all. Kept as the fallback formulation (forced splits pin it) and as
+    the ablation baseline the collective auditor compares against.
 - The machines/ports machinery (``linkers_socket.cpp``) is replaced by
   ``jax.distributed`` + the mesh; topology/algorithm selection
   (Bruck/recursive-halving, ``linker_topo.cpp``) becomes XLA's problem.
 
 Feature-parallel and voting-parallel (SURVEY.md §2.3) remap here too:
 with rows replicated and features sharded the same program becomes
-feature-parallel (psum degenerates to a no-op on feature-disjoint
-histograms); voting's top-k communication saving is unnecessary on ICI
-bandwidth but can be added as a histogram-subset psum later.
+feature-parallel (slot histograms are feature-disjoint, so NO histogram
+collective is emitted at all — the auditor asserts zero); voting's
+elected-column merge rides the same ``hist_merge`` knob — under
+``reduce_scatter`` the top-2k sub-histogram merges into the scattered
+slot space instead of replicating.
+
+``parallel/comms.py`` audits the compiled HLO of these programs:
+collective op counts, per-op bytes, and the allreduce-vs-reduce_scatter
+byte ratio (``scripts/audit_collectives.py`` wires it into CI).
 """
 
 from __future__ import annotations
@@ -41,9 +62,32 @@ from ..ops.split import SplitParams
 from ..boosting.tree_builder import build_tree, TreeArrays
 
 __all__ = ["make_mesh", "shard_rows", "replicate", "build_tree_dp",
+           "resolve_hist_merge",
            "DataParallelPlan", "VotingParallelPlan", "FeatureParallelPlan"]
 
 AXIS = "data"
+
+HIST_MERGE_MODES = ("auto", "allreduce", "reduce_scatter")
+
+
+def resolve_hist_merge(mode: str, n_shards: int) -> str:
+    """Resolve the ``dp_hist_merge`` knob to a concrete collective.
+
+    ``LIGHTGBM_TPU_DP_HIST_MERGE`` overrides the param (the same env-pin
+    pattern as LIGHTGBM_TPU_FUSED_TRAIN); ``auto`` picks
+    ``reduce_scatter`` on any multi-chip mesh and degenerates to
+    ``allreduce`` on one shard (where both lower to nothing)."""
+    import os
+    env = os.environ.get("LIGHTGBM_TPU_DP_HIST_MERGE", "")
+    if env:
+        mode = env
+    if mode not in HIST_MERGE_MODES:
+        raise ValueError(
+            f"dp_hist_merge must be one of {HIST_MERGE_MODES}, "
+            f"got {mode!r}")
+    if mode == "auto":
+        return "reduce_scatter" if n_shards > 1 else "allreduce"
+    return mode
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
@@ -99,11 +143,16 @@ class DataParallelPlan:
     rows_sharded = True
 
     def __init__(self, devices: Optional[Sequence[jax.Device]] = None,
-                 axis_name: str = AXIS, top_k: int = 20):
+                 axis_name: str = AXIS, top_k: int = 20,
+                 hist_merge: str = "auto"):
         self.mesh = make_mesh(devices, axis_name)
         self.axis_name = axis_name
         self.num_shards = self.mesh.devices.size
         self.top_k = top_k
+        # histogram merge collective (reduce_scatter on real meshes —
+        # see the module docstring); resolved once, after the mesh size
+        # is known
+        self.hist_merge = resolve_hist_merge(hist_merge, self.num_shards)
         # multi-host: each process feeds its own pre-partitioned row
         # shard (the rank/num_machines loading path of
         # dataset_loader.cpp:203); device_put cannot address remote
@@ -206,15 +255,19 @@ class DataParallelPlan:
             bundle_meta=bundle_meta, bundle_bins=bundle_bins,
             quant_scales=quant_scales, mono_method=mono_method,
             cat_sorted_mask=cat_sorted_mask, forced=forced,
-            hist_sub=hist_sub)
+            hist_sub=hist_sub, hist_merge=self.hist_merge)
 
 
 class VotingParallelPlan(DataParallelPlan):
     """PV-Tree voting-parallel (voting_parallel_tree_learner.cpp:16-120):
     same row sharding as data-parallel, but per-round communication is
     votes + the elected feature columns only — O(top_k*B) instead of
-    O(F*B). Use when F*B is large enough that the histogram psum
-    dominates ICI/DCN time."""
+    O(F*B). Use when F*B is large enough that the histogram merge
+    dominates ICI/DCN time. Rides the same ``hist_merge`` knob: under
+    ``reduce_scatter`` the elected top-2k column merge lands
+    slot-SHARDED (each chip searches its elected-column block, winners
+    sync SplitInfo-sized) instead of replicating — wire bytes halve
+    again on top of the election saving."""
     parallel_mode = "voting"
 
 
@@ -434,17 +487,19 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                      "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "n_valid", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins",
-                     "mono_method", "forced", "hist_sub"))
+                     "mono_method", "forced", "hist_sub", "hist_merge"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
                        split_params, axis_name, hist_dtype, hist_impl, block_rows,
                        n_valid, feature_fraction_bynode,
                        parallel_mode="data", top_k=20, bundle_bins=0,
-                       mono_method="basic", forced=None, hist_sub=True):
+                       mono_method="basic", forced=None, hist_sub=True,
+                       hist_merge="allreduce"):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
+    n_shards = int(mesh.devices.size)
 
     def step(b, g, rl, nbpf, nanpf, catpf, fmask, vflat, extra):
         vbins = tuple(vflat[:n_valid])
@@ -463,7 +518,8 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             parallel_mode=parallel_mode, top_k=top_k,
             bundle_meta=bmeta, bundle_bins=bundle_bins,
             quant_scales=qs, mono_method=mono_method,
-            cat_sorted_mask=csm, forced=forced, hist_sub=hist_sub)
+            cat_sorted_mask=csm, forced=forced, hist_sub=hist_sub,
+            hist_merge=hist_merge, n_shards=n_shards)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
@@ -473,11 +529,18 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     # and constrains identically, keeping the replicated argmax in sync
     extras_specs = jax.tree.map(lambda _: rep, extras)
 
+    # reduce-scatter layout: the scattered shard and the axis-indexed
+    # metadata slices VARY across shards on purpose; _sync_best restores
+    # replicated tree outputs. The static replication checker cannot
+    # prove that through the while_loop (the feature-parallel build
+    # disables it for the same reason), so turn it off here too.
+    rs = hist_merge == "reduce_scatter" and n_shards > 1
     fn = _shard_map(
         step, mesh=mesh,
         in_specs=(row2, row2, row, rep, rep, rep, rep, valid_in_specs,
                   extras_specs),
-        out_specs=(tree_specs, row, out_valid_specs))
+        out_specs=(tree_specs, row, out_valid_specs),
+        check_vma=False if rs else None)
     return fn(bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
               feature_mask, valid_flat, extras)
 
@@ -496,12 +559,13 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   bundle_meta=None, bundle_bins: int = 0,
                   quant_scales=None, mono_method: str = "basic",
                   cat_sorted_mask=None, forced=None,
-                  hist_sub: bool = True):
+                  hist_sub: bool = True, hist_merge: str = "allreduce"):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
     returned TreeArrays are replicated (identical on every chip), the
-    returned row→leaf assignments stay row-sharded.
+    returned row→leaf assignments stay row-sharded. ``hist_merge``
+    selects the histogram merge collective (module docstring).
     """
     valid_flat = tuple(valid_bins) + tuple(valid_row_leaf0)
     extras = (mono_type_pf, interaction_groups, rng_key, bundle_meta,
@@ -517,4 +581,4 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
         feature_fraction_bynode=feature_fraction_bynode,
         parallel_mode=parallel_mode, top_k=top_k,
         bundle_bins=bundle_bins, mono_method=mono_method, forced=forced,
-        hist_sub=hist_sub)
+        hist_sub=hist_sub, hist_merge=hist_merge)

@@ -31,6 +31,15 @@ What the trace attributes, per layer:
 
   Metric evaluation at eval-cadence points is wrapped in the ``eval``
   phase by engine.train.
+
+- Collective phases — ``hist_merge`` wraps the cross-chip histogram
+  merge (psum or psum_scatter, ops/histogram.merge_histograms) and
+  ``winner_sync`` the SplitInfo-sized best-split merge
+  (tree_builder._sync_best). Besides grouping device time in trace
+  viewers, these names reach the compiled HLO as op-name prefixes,
+  which is how the collective-traffic auditor (parallel/comms.py)
+  attributes histogram traffic when it walks a program's collectives —
+  renaming a phase here breaks that attribution, keep them in sync.
 """
 
 from __future__ import annotations

@@ -188,6 +188,35 @@ _WORKER = textwrap.dedent("""
         with open(os.path.join(outdir, f"model_{rank}.txt"), "w") as f:
             f.write(txt)
         sys.exit(0)
+    if mode == "reduce_scatter":
+        # ISSUE 4: the feature-slot-scattered histogram merge crosses
+        # PROCESSES here (2 hosts x 4 devices: psum_scatter rides the
+        # inter-process link, winner sync merges cross-host). auto must
+        # resolve to reduce_scatter on the 8-shard mesh and the result
+        # must be bit-equal to the allreduce merge on the same shards.
+        cut = 2200
+        sl = slice(0, cut) if rank == 0 else slice(cut, n)
+        common = {"objective": "binary", "num_leaves": 15,
+                  "tree_learner": "data", "min_data_in_leaf": 5,
+                  "pre_partition": True, "verbosity": -1}
+        bst = lgb.train(common, lgb.Dataset(
+            X[sl], label=y[sl], params={"pre_partition": True}), 8)
+        assert bst._gbdt.plan.hist_merge == "reduce_scatter", \
+            bst._gbdt.plan.hist_merge
+        bst_ar = lgb.train(dict(common, dp_hist_merge="allreduce"),
+                           lgb.Dataset(X[sl], label=y[sl],
+                                       params={"pre_partition": True}),
+                           8)
+        np.testing.assert_array_equal(bst.predict(X[sl]),
+                                      bst_ar.predict(X[sl]))
+        txt = bst.model_to_string()
+        from sklearn.metrics import roc_auc_score
+        auc = roc_auc_score(y[sl], bst.predict(X[sl]))
+        with open(os.path.join(outdir, f"out_{rank}.json"), "w") as f:
+            json.dump({"auc": auc}, f)
+        with open(os.path.join(outdir, f"model_{rank}.txt"), "w") as f:
+            f.write(txt)
+        sys.exit(0)
     if mode == "init_model":
         # continued training across hosts (VERDICT r4 #4 remainder):
         # each host predicts its own pre-partitioned rows with the
@@ -269,6 +298,15 @@ def test_two_process_data_parallel_training(tmp_path):
 @pytest.mark.slow
 def test_two_process_auto_partition_training(tmp_path):
     _run_two_workers(tmp_path, "auto")
+
+
+@pytest.mark.slow
+def test_two_process_reduce_scatter_training(tmp_path):
+    """ISSUE 4: the scattered histogram merge over a 2-process x
+    4-device global mesh — auto resolves to reduce_scatter, workers
+    produce the identical model, and predictions are bit-equal to the
+    allreduce merge on the same shards."""
+    _run_two_workers(tmp_path, "reduce_scatter")
 
 
 @pytest.mark.slow
