@@ -49,6 +49,19 @@ import numpy as np
 BASELINE_ROW_TREES_PER_S = 10_500_000 * 500 / 130.094  # Experiments.rst:113
 
 
+def _probe():
+    """The shared subprocess-probe harness (scripts/_probe.py — env
+    pinning, timeout, TAG=json contract); loaded by path because
+    scripts/ is not a package."""
+    import importlib.util
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "_probe", os.path.join(here, "scripts", "_probe.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def make_higgs_like(n_rows: int, n_feat: int = 28, seed: int = 7):
     """Synthetic stand-in with Higgs-like shape: dense floats, a nonlinear
     decision surface, balanced classes."""
@@ -609,11 +622,10 @@ def dp_comm_bench() -> dict:
     (ISSUE 4): the same data-parallel training run under
     dp_hist_merge=allreduce vs reduce_scatter — ms_per_tree for both,
     plus the per-chip histogram-collective bytes per tree from the
-    static auditor (parallel/comms). Subprocess-isolated: the
-    virtual-device XLA flag must be set before jax initializes, and the
-    main bench process owns the real backend. BENCH_DP_COMM=0 skips."""
-    import subprocess
-    import tempfile
+    static auditor (parallel/comms). Subprocess-isolated via the shared
+    probe harness: the virtual-device XLA flag must be set before jax
+    initializes, and the main bench process owns the real backend.
+    BENCH_DP_COMM=0 skips."""
     rows = int(os.environ.get("BENCH_DP_COMM_ROWS", 1 << 16))
     iters = int(os.environ.get("BENCH_DP_COMM_ITERS", 8))
     script = f"""
@@ -658,39 +670,20 @@ out["dp_merge_bit_identical"] = bool(
     np.array_equal(preds["allreduce"], preds["reduce_scatter"]))
 print("DPCOMM=" + json.dumps(out))
 """
-    here = os.path.dirname(os.path.abspath(__file__))
-    env = dict(os.environ,
-               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
-                          + " --xla_force_host_platform_device_count=8"
-                          ).strip(),
-               JAX_PLATFORMS="cpu", LIGHTGBM_TPU_FUSED_TRAIN="0",
-               PYTHONPATH=(here + os.pathsep
-                           + os.environ.get("PYTHONPATH", "")))
-    with tempfile.NamedTemporaryFile("w", suffix=".py",
-                                     delete=False) as f:
-        f.write(script)
-        path = f.name
-    try:
-        r = subprocess.run([sys.executable, path], cwd=here, env=env,
-                           capture_output=True, text=True, timeout=900)
-        for ln in r.stdout.splitlines():
-            if ln.startswith("DPCOMM="):
-                return json.loads(ln.split("=", 1)[1])
-        return {"dp_comm_error":
-                (r.stderr or "no output").strip()[-300:]}
-    except subprocess.TimeoutExpired:
-        return {"dp_comm_error": "timeout"}
-    finally:
-        os.unlink(path)
+    probe = _probe()
+    out, err = probe.run_code_probe(
+        script, "DPCOMM", env=probe.mesh_env(8, fused=False),
+        timeout=900)
+    return out if err is None else {"dp_comm_error": err}
 
 
 def compile_cache_probe() -> dict:
     """Cold vs warm compile+warmup seconds through the persistent XLA
     compilation cache (engine.enable_compilation_cache): the identical
     tiny training run in two fresh subprocesses sharing one cache dir.
-    Subprocess-isolated so a (de)serialization crash — the known CPU
-    jaxlib hazard — degrades to an error field, never kills the bench."""
-    import subprocess
+    Subprocess-isolated (shared probe harness) so a (de)serialization
+    crash — the known CPU jaxlib hazard — degrades to an error field,
+    never kills the bench."""
     import tempfile
     script = (
         "import os, time\n"
@@ -705,26 +698,19 @@ def compile_cache_probe() -> dict:
         "               verbosity=-1), ds, num_boost_round=3)\n"
         "print('TRAIN_S=%.3f' % (time.time() - t0))\n")
     out = {}
-    here = os.path.dirname(os.path.abspath(__file__))
+    probe = _probe()
     with tempfile.TemporaryDirectory(prefix="bench_cc_") as td:
         env = dict(os.environ, LIGHTGBM_TPU_CACHE_DIR=td,
-                   LIGHTGBM_TPU_COMPILE_CACHE="1")
+                   LIGHTGBM_TPU_COMPILE_CACHE="1",
+                   PYTHONPATH=(probe.REPO_ROOT + os.pathsep
+                               + os.environ.get("PYTHONPATH", "")))
         for tag in ("cold", "warm"):
-            try:
-                r = subprocess.run(
-                    [sys.executable, "-c", script], cwd=here, env=env,
-                    capture_output=True, text=True, timeout=600)
-                for ln in r.stdout.splitlines():
-                    if ln.startswith("TRAIN_S="):
-                        out[f"compile_cache_{tag}_s"] = float(
-                            ln.split("=", 1)[1])
-                if r.returncode != 0:
-                    out[f"compile_cache_{tag}_error"] = \
-                        (r.stderr or "crashed").strip()[-300:]
-                    break
-            except subprocess.TimeoutExpired:
-                out[f"compile_cache_{tag}_error"] = "timeout"
+            secs, err = probe.run_code_probe(
+                script, "TRAIN_S", env=env, timeout=600, decode=float)
+            if err is not None:
+                out[f"compile_cache_{tag}_error"] = err
                 break
+            out[f"compile_cache_{tag}_s"] = secs
         n_entries = sum(len(fs) for _, _, fs in os.walk(td))
         out["compile_cache_entries"] = n_entries
     cold = out.get("compile_cache_cold_s")

@@ -1,0 +1,111 @@
+"""Compiled-HLO lint: hazards only visible after XLA lowering.
+
+The jaxpr pass (``jaxpr_lint.py``) sees the program as traced; this
+pass sees it as COMPILED — post-SPMD-partitioning, post-fusion — which
+is where the remaining hazard classes live:
+
+- **TD101 oversized constant**: a dense ``constant`` op above the size
+  threshold. The jaxpr pass catches closure constants at their source;
+  this catches the same class after lowering (including constants XLA
+  materializes itself), so a regression cannot slip through either
+  door.
+- **TD102 host transfer**: ``infeed`` / ``outfeed`` / ``send`` /
+  ``recv`` ops, or ``custom-call``s into the Python callback runtime
+  (``xla_python_cpu_callback`` and friends). Any of these in a hot-path
+  program forces a device→host sync per dispatch.
+- **TD103 out-of-phase collective**: a collective moving at least
+  ``min_collective_bytes`` whose ``op_name`` metadata carries none of
+  the program's allowed profiler phases (``phases.py``). The
+  comms auditor attributes traffic by phase tags; an untagged
+  collective is traffic the audit cannot see — exactly how the
+  feature-parallel Pallas path's unconditional full-histogram ``psum``
+  hid (PR 4). Small untagged collectives (scalar syncs XLA introduces)
+  report as info, not error.
+- **TD004 CPU donation** (shared id with the jaxpr rule): the module
+  header's ``input_output_alias`` is non-empty while the backend is
+  CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from ..phases import COLLECTIVE_PHASES
+from .hlo_walk import input_output_aliases, parse_collective_ops, parse_ops
+from .report import TraceReport
+
+__all__ = ["lint_hlo", "DEFAULT_CONST_BYTES",
+           "DEFAULT_MIN_COLLECTIVE_BYTES", "HOST_TRANSFER_OPS"]
+
+DEFAULT_CONST_BYTES = 1 << 20            # 1 MiB
+DEFAULT_MIN_COLLECTIVE_BYTES = 4096      # SplitInfo winner syncs ~100s B
+
+HOST_TRANSFER_OPS = ("infeed", "outfeed", "send", "recv")
+_CALLBACK_TARGET_MARKERS = ("callback", "xla_python", "py_func")
+
+
+def lint_hlo(hlo_text: str, *, label: str,
+             max_const_bytes: int = DEFAULT_CONST_BYTES,
+             allowed_phases: Optional[frozenset] = None,
+             enforce_phases: bool = True,
+             min_collective_bytes: int = DEFAULT_MIN_COLLECTIVE_BYTES,
+             allow_host_transfers: bool = False,
+             backend: Optional[str] = None,
+             allow: Sequence[Tuple[str, str]] = ()) -> TraceReport:
+    """Lint one compiled program's HLO text.
+
+    ``allowed_phases`` defaults to the collective phases every tree
+    program may emit (``hist_merge`` / ``winner_sync``);
+    ``enforce_phases=False`` skips TD103 for programs with no phase
+    contract (e.g. the predict walk, which must emit no collectives at
+    all — pass ``allowed_phases=frozenset()`` to assert that instead).
+    """
+    import jax
+    rep = TraceReport(label=label)
+    backend = backend or jax.default_backend()
+    if allowed_phases is None:
+        allowed_phases = COLLECTIVE_PHASES
+
+    # TD101 — oversized dense constants
+    for op in parse_ops(hlo_text, ("constant",)):
+        if op.out_bytes >= max_const_bytes:
+            rep.add("TD101", "error", op.op_name or "constant",
+                    "oversized dense constant in the compiled program; "
+                    "pass the data as an argument instead of closing "
+                    "over it", nbytes=op.out_bytes)
+
+    # TD102 — host transfers
+    if not allow_host_transfers:
+        for op in parse_ops(hlo_text, HOST_TRANSFER_OPS):
+            rep.add("TD102", "error", op.op_name or op.opcode,
+                    f"host transfer op `{op.opcode}` in a hot-path "
+                    "program", nbytes=op.out_bytes)
+        for op in parse_ops(hlo_text, ("custom-call",)):
+            tgt = op.custom_call_target
+            if any(m in tgt for m in _CALLBACK_TARGET_MARKERS):
+                rep.add("TD102", "error", op.op_name or tgt,
+                        f"host callback custom-call `{tgt}`; each "
+                        "dispatch round-trips through Python")
+
+    # TD103 — collectives outside the allowed phases
+    if enforce_phases:
+        for op in parse_collective_ops(hlo_text):
+            if any(p in op.op_name for p in allowed_phases):
+                continue
+            sev = ("error" if op.out_bytes >= min_collective_bytes
+                   else "info")
+            rep.add("TD103", sev, op.op_name or op.opcode,
+                    f"{op.opcode} outside the allowed phases "
+                    f"({'/'.join(sorted(allowed_phases)) or 'none'}); "
+                    "untagged collectives are invisible to the comms "
+                    "audit", nbytes=op.out_bytes)
+
+    # TD004 — donation on the CPU backend
+    alias = input_output_aliases(hlo_text)
+    if alias and backend == "cpu":
+        rep.add("TD004", "error", "input_output_alias",
+                "program donates input buffers on the CPU backend: "
+                f"alias map {{{alias}}}; zero-copy np.asarray views of "
+                "CPU jax arrays alias donated buffers and in-place "
+                "writes corrupt them")
+    return rep.apply_allowlist(allow)

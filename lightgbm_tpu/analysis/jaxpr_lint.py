@@ -1,0 +1,146 @@
+"""jaxpr-level lint: compile-time hazards visible before XLA runs.
+
+Walks a ``ClosedJaxpr`` (the output of ``jax.make_jaxpr`` — tracing
+only, no XLA compile, so this pass is cheap enough for tight test
+loops) and flags the hazard classes that previous PRs root-caused by
+hand:
+
+- **TD001 dense closure constant**: a concrete array closed over by the
+  traced function lands in ``ClosedJaxpr.consts`` and is embedded into
+  the lowered module as a dense HLO constant. At Higgs scale the fused
+  step's closed-over bin matrix was a ~300 MB constant per program plus
+  XLA constant-folding stalls (PR 3); the fix was passing the arrays as
+  arguments (``gbdt._fused_data_args``), and this rule keeps it fixed.
+- **TD002 host callback**: ``debug_callback`` / ``pure_callback`` /
+  ``io_callback`` primitives staged into a hot-path program force a
+  host round-trip per dispatch — sync-free dispatch-ahead training is
+  impossible with one in the trace.
+- **TD003 dtype widening**: ``convert_element_type`` to f64 inside
+  traced code. The repo's numerics are f32/bf16/int8 by design (PARITY
+  holds at f32); an accidental f64 op doubles bandwidth on TPU and
+  silently de-pairs results from the reference.
+- **TD004 CPU donation**: ``pjit`` equations carrying donated invars
+  while the backend is CPU. Zero-copy ``np.asarray`` views of CPU jax
+  arrays alias the donated buffers, so the next in-place write corrupts
+  live host views (the PR-3 corrupted-valid-metrics incident); the
+  trainer pins no-donate on CPU and this rule enforces it repo-wide.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+from .report import TraceReport
+
+__all__ = ["lint_jaxpr", "iter_eqns", "CALLBACK_PRIMITIVES",
+           "DEFAULT_CONST_BYTES"]
+
+# primitive names that round-trip through the host per dispatch
+CALLBACK_PRIMITIVES = frozenset({
+    "debug_callback", "pure_callback", "io_callback",
+    "outside_call", "host_callback_call", "debug_print"})
+
+# floats narrower than f64 — widening any of these to f64 is TD003
+_NARROW_FLOATS = ("float32", "bfloat16", "float16")
+
+DEFAULT_CONST_BYTES = 1 << 20       # 1 MiB
+
+
+def _sub_jaxprs(params):
+    """Nested jaxprs of one equation's params (pjit/scan/while carry a
+    single `jaxpr`; cond carries `branches`; custom_* carry call
+    jaxprs). Yields ClosedJaxpr-or-Jaxpr values."""
+    for v in params.values():
+        if hasattr(v, "jaxpr") or hasattr(v, "eqns"):
+            yield v
+        elif isinstance(v, (list, tuple)):
+            for vv in v:
+                if hasattr(vv, "jaxpr") or hasattr(vv, "eqns"):
+                    yield vv
+
+
+def iter_eqns(jaxpr):
+    """Depth-first over every equation, descending into nested call /
+    control-flow jaxprs (pjit, scan, while, cond branches, shard_map,
+    custom_jvp/vjp)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            yield from iter_eqns(inner)
+
+
+def _const_entries(closed):
+    """(index, const) for the top-level consts plus nested pjit consts
+    (a closure constant can hide one jit level down)."""
+    out = list(enumerate(closed.consts))
+    base = len(out)
+    for eqn in iter_eqns(closed.jaxpr):
+        sub = eqn.params.get("jaxpr")
+        if sub is not None and hasattr(sub, "consts"):
+            for c in sub.consts:
+                out.append((base, c))
+                base += 1
+    return out
+
+
+def lint_jaxpr(closed, *, label: str,
+               max_const_bytes: int = DEFAULT_CONST_BYTES,
+               allow_callbacks: bool = False,
+               backend: Optional[str] = None,
+               allow: Sequence[Tuple[str, str]] = ()) -> TraceReport:
+    """Lint one ``ClosedJaxpr``; returns the :class:`TraceReport`.
+
+    ``allow_callbacks`` relaxes TD002 for programs where a callback is
+    the point (debug harnesses); ``backend`` defaults to
+    ``jax.default_backend()`` and gates TD004 (donation is the right
+    call on accelerators — only CPU aliases host views).
+    """
+    import jax
+    rep = TraceReport(label=label)
+    backend = backend or jax.default_backend()
+
+    # TD001 — dense closure constants
+    for idx, c in _const_entries(closed):
+        shape = getattr(c, "shape", None)
+        dtype = getattr(c, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        nbytes = int(getattr(c, "size", 0)) * dtype.itemsize
+        if nbytes >= max_const_bytes:
+            rep.add("TD001", "error", f"const[{idx}]",
+                    f"dense {dtype} {tuple(shape)} closure constant "
+                    "embedded in the program; pass it as an argument "
+                    "(see gbdt._fused_data_args)", nbytes=nbytes)
+
+    donated_seen = False
+    for eqn in iter_eqns(closed.jaxpr):
+        name = eqn.primitive.name
+        # TD002 — host callbacks
+        if name in CALLBACK_PRIMITIVES and not allow_callbacks:
+            rep.add("TD002", "error", name,
+                    "host callback staged into a hot-path program; "
+                    "each dispatch round-trips through Python")
+        # TD003 — f64 widening
+        if name == "convert_element_type":
+            new = str(eqn.params.get("new_dtype", ""))
+            src = str(eqn.invars[0].aval.dtype) \
+                if eqn.invars and hasattr(eqn.invars[0], "aval") else ""
+            if new == "float64" and src in _NARROW_FLOATS:
+                rep.add("TD003", "error", name,
+                        f"dtype widening {src} -> float64 inside "
+                        "traced code; the repo's numerics are "
+                        "f32/bf16/int8 by design")
+        # TD004 — donation on CPU
+        if name == "pjit" and not donated_seen:
+            if any(eqn.params.get("donated_invars") or ()):
+                donated_seen = True
+                if backend == "cpu":
+                    rep.add(
+                        "TD004", "error", f"pjit:{eqn.params.get('name', '')}",
+                        "buffer donation compiled on the CPU backend: "
+                        "zero-copy np.asarray views alias donated "
+                        "buffers and the next in-place write corrupts "
+                        "them (gate donation on "
+                        "jax.default_backend() != 'cpu')")
+    return rep.apply_allowlist(allow)

@@ -27,7 +27,7 @@ bit-identical; parity tests are statistical (metric levels), not bitwise.
 from __future__ import annotations
 
 import numpy as np
-from typing import List, Optional, Sequence
+from typing import List, Optional
 
 __all__ = ["BinMapper", "kZeroThreshold", "MISSING_NONE", "MISSING_ZERO",
            "MISSING_NAN"]

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
-import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config

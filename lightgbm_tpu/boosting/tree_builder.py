@@ -66,13 +66,12 @@ Constraint machinery (all vectorized, no data-dependent shapes):
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Sequence, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ..native import jax_ffi as _jax_ffi
-import numpy as np
 
 from ..ops.histogram import (build_histograms, resolve_impl, HIST_CH,
                              merge_histograms, _pvary)

@@ -27,7 +27,7 @@ still reports the true iteration index.
 from __future__ import annotations
 
 import collections
-from typing import Any, Callable, Dict, List
+from typing import Callable, Dict, List
 
 from . import log
 

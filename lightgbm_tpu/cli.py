@@ -216,12 +216,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
               "       python -m lightgbm_tpu serve model=<file> "
               "[port=8080 ...]\n"
-              "tasks: train | predict | refit | save_binary | serve")
+              "       python -m lightgbm_tpu trace-doctor [--config ...]"
+              " [--mode ...]\n"
+              "tasks: train | predict | refit | save_binary | serve | "
+              "trace-doctor")
         return 0
     # `python -m lightgbm_tpu serve model=...` — subcommand spelling of
     # task=serve (the reference CLI is key=value only; serve is ours)
     if argv[0] == "serve":
         argv = ["task=serve"] + argv[1:]
+    # `trace-doctor` — the static-analysis battery (analysis/doctor.py);
+    # argparse-style flags, not key=value, so it dispatches before run()
+    if argv[0] in ("trace-doctor", "trace_doctor"):
+        from .analysis.doctor import doctor_main
+        return doctor_main(argv[1:])
     return run(_parse_argv(argv))
 
 

@@ -16,7 +16,7 @@ Differences from the reference, by design:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 __all__ = ["Config", "ParamSpec", "PARAMS", "ALIASES", "parse_params"]
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import os
 import numpy as np
-from typing import Any, Dict, List, Optional, Union
+from typing import Dict, List, Optional
 
 from .binning import BinMapper
 from .config import Config

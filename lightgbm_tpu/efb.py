@@ -29,7 +29,7 @@ reference's FixHistogram most-frequent-bin accounting
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence
+from typing import List, Sequence
 
 import numpy as np
 

@@ -11,8 +11,7 @@ from __future__ import annotations
 
 import collections
 import copy
-from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, \
-    Union
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 

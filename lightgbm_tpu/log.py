@@ -12,7 +12,7 @@ Level mapping follows config.h ``verbosity``: <0 fatal-only, 0 warning,
 from __future__ import annotations
 
 import sys
-from typing import Any, Callable, Optional
+from typing import Any, Optional
 
 __all__ = ["register_logger", "set_verbosity", "debug", "info", "warning",
            "fatal"]

@@ -15,7 +15,7 @@ comparable to the reference bit-for-bit-ish. Each metric reports
 from __future__ import annotations
 
 import numpy as np
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import List, Tuple
 
 from .config import Config
 

@@ -14,7 +14,7 @@ multiply both grad and hess, as in the reference.
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

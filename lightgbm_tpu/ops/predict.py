@@ -15,9 +15,6 @@ tree builder (no serializing dynamic gather on the lane axis).
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 

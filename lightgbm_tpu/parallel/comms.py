@@ -21,30 +21,18 @@ the allreduce baseline's histogram bytes), by ``tests/test_comm_audit.py``
 from __future__ import annotations
 
 import dataclasses
-import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..analysis.hlo_walk import (COLLECTIVE_KINDS,
+                                 lower_hlo as _walk_lower_hlo,
+                                 parse_collective_ops)
+from ..phases import HIST_MERGE, WINNER_SYNC
+
 __all__ = ["CollectiveOp", "CommReport", "parse_collectives",
            "lower_hlo", "audit_fn", "audit_tree_program", "audit_plans",
-           "hist_bytes_per_tree", "render_table"]
-
-COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
-                    "all-to-all", "collective-permute")
-
-_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
-                "f16": 2, "bf16": 2, "s32": 4, "u32": 4, "f32": 4,
-                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
-
-# `%name = f32[2,4]{1,0} reduce-scatter(...)` — tuple outputs wrap the
-# shapes in parentheses. `-start` covers the async TPU forms; `-done`
-# ops carry no payload of their own and are skipped.
-_OP_RE = re.compile(
-    r"=\s*(?P<out>\([^)]*\)|[\w\[\],{}]+?)\s+"
-    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?:-start)?\(")
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-_NAME_RE = re.compile(r'op_name="([^"]*)"')
+           "hist_bytes_per_tree", "render_table", "COLLECTIVE_KINDS"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -58,12 +46,12 @@ class CollectiveOp:
     @property
     def is_hist(self) -> bool:
         """Histogram-merge traffic (tagged by merge_histograms)."""
-        return "hist_merge" in self.op_name
+        return HIST_MERGE in self.op_name
 
     @property
     def is_winner_sync(self) -> bool:
         """SplitInfo-sized winner merge (_sync_best)."""
-        return "winner_sync" in self.op_name
+        return WINNER_SYNC in self.op_name
 
     def wire_bytes(self, n: int) -> int:
         """Per-chip wire-traffic estimate under ring algorithms:
@@ -82,26 +70,12 @@ class CollectiveOp:
 
 
 def parse_collectives(hlo_text: str) -> List[CollectiveOp]:
-    """Extract every collective op from compiled-HLO text."""
-    ops = []
-    for line in hlo_text.splitlines():
-        m = _OP_RE.search(line)
-        if m is None or "-done(" in line:
-            continue
-        shapes = []
-        nbytes = 0
-        for dt, dims in _SHAPE_RE.findall(m.group("out")):
-            if dt not in _DTYPE_BYTES:
-                continue            # layout annotations like {1,0}
-            shape = tuple(int(d) for d in dims.split(",") if d)
-            shapes.append((dt, shape))
-            nbytes += int(np.prod(shape, dtype=np.int64)) \
-                * _DTYPE_BYTES[dt]
-        nm = _NAME_RE.search(line)
-        ops.append(CollectiveOp(kind=m.group("kind"),
-                                shapes=tuple(shapes), out_bytes=nbytes,
-                                op_name=nm.group(1) if nm else ""))
-    return ops
+    """Extract every collective op from compiled-HLO text (the shared
+    walker, ``analysis/hlo_walk.py``, owns the parsing; this wraps its
+    generic ops into the comms accounting type)."""
+    return [CollectiveOp(kind=o.opcode, shapes=o.shapes,
+                         out_bytes=o.out_bytes, op_name=o.op_name)
+            for o in parse_collective_ops(hlo_text)]
 
 
 @dataclasses.dataclass
@@ -147,8 +121,7 @@ def lower_hlo(fn, *args) -> str:
     """Compiled (post-SPMD) HLO text of ``jit(fn)(*args)``. Nested jits
     (the plans' inner pjits) inline into the one lowered module, so the
     collectives of the whole tree build are visible."""
-    import jax
-    return jax.jit(fn).lower(*args).compile().as_text()
+    return _walk_lower_hlo(fn, *args)
 
 
 def audit_fn(fn, *args, label: str = "program",

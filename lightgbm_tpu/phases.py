@@ -1,0 +1,45 @@
+"""Canonical profiler/auditor phase names — ONE source of truth.
+
+Three layers are coupled through these strings:
+
+1. ``profiler.phase`` emits them as TraceAnnotation spans and
+   ``jax.named_scope`` prefixes, so every XLA op staged under a phase
+   carries ``<name>/`` in its HLO ``op_name`` metadata;
+2. the collective-traffic auditor (``parallel/comms.py``) attributes
+   histogram traffic by searching compiled-HLO op names for
+   :data:`HIST_MERGE` / :data:`WINNER_SYNC`;
+3. the trace doctor (``analysis/hlo_lint.py``) treats any sizeable
+   collective whose op name carries NONE of a program's allowed phase
+   tags as out-of-phase (rule TD103).
+
+Before this module the names were retyped string literals in each
+layer, so renaming a phase at an emission site silently broke the
+auditors' attribution (they would just stop matching). Now the emission
+side (``profiler.phase``) asserts membership in :data:`KNOWN_PHASES` at
+annotation time, and every consumer imports the constant instead of
+retyping it — a rename is a one-line change here or an immediate
+ValueError, never a silent attribution miss.
+"""
+
+from __future__ import annotations
+
+__all__ = ["GRADS", "SAMPLING", "BUILD", "UPDATE", "EVAL",
+           "HIST_MERGE", "WINNER_SYNC", "TRAIN_PHASES",
+           "COLLECTIVE_PHASES", "KNOWN_PHASES"]
+
+# training phases (both drivers, boosting/gbdt.py + engine.train's eval)
+GRADS = "grads"
+SAMPLING = "sampling"
+BUILD = "build"
+UPDATE = "update"
+EVAL = "eval"
+
+# collective phases (ops/histogram.merge_histograms,
+# boosting/tree_builder._sync_best) — these reach compiled HLO as
+# op-name prefixes and carry the auditors' traffic attribution
+HIST_MERGE = "hist_merge"
+WINNER_SYNC = "winner_sync"
+
+TRAIN_PHASES = frozenset({GRADS, SAMPLING, BUILD, UPDATE, EVAL})
+COLLECTIVE_PHASES = frozenset({HIST_MERGE, WINNER_SYNC})
+KNOWN_PHASES = TRAIN_PHASES | COLLECTIVE_PHASES

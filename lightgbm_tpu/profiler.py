@@ -37,15 +37,20 @@ What the trace attributes, per layer:
   ``winner_sync`` the SplitInfo-sized best-split merge
   (tree_builder._sync_best). Besides grouping device time in trace
   viewers, these names reach the compiled HLO as op-name prefixes,
-  which is how the collective-traffic auditor (parallel/comms.py)
-  attributes histogram traffic when it walks a program's collectives —
-  renaming a phase here breaks that attribution, keep them in sync.
+  which is how the collective-traffic auditor (parallel/comms.py) and
+  the trace doctor (analysis/hlo_lint.py) attribute a program's
+  collectives. The canonical name set lives in ``phases.py``;
+  :func:`phase` asserts membership at annotation time, so a renamed
+  phase is an immediate ValueError instead of a silent attribution
+  miss in the auditors.
 """
 
 from __future__ import annotations
 
 import contextlib
 from typing import Iterator, Optional
+
+from .phases import KNOWN_PHASES
 
 __all__ = ["trace", "step_annotation", "annotate", "phase"]
 
@@ -82,7 +87,17 @@ def phase(name: str) -> Iterator[None]:
     ``TraceAnnotation`` span (meaningful around eager dispatches — the
     legacy loop, engine eval) AND a ``jax.named_scope`` so ops staged
     inside an ambient trace (the fused step) carry ``name/`` as an op
-    prefix the profiler groups by."""
+    prefix the profiler groups by.
+
+    ``name`` must be one of the canonical phases (``phases.py``): the
+    collective auditors attribute HLO traffic by these strings, so an
+    unknown name would emit spans nothing downstream can account for.
+    """
+    if name not in KNOWN_PHASES:
+        raise ValueError(
+            f"unknown profiler phase {name!r}; canonical phases are "
+            f"{sorted(KNOWN_PHASES)} (lightgbm_tpu/phases.py — add new "
+            "phases there so the HLO auditors keep attributing them)")
     import jax
     with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
         yield

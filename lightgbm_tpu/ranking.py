@@ -13,8 +13,6 @@ shapes. Padded lanes carry zero weight.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 import numpy as np

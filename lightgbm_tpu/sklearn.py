@@ -11,7 +11,6 @@ directly instead of a ctypes C API.
 
 from __future__ import annotations
 
-import copy
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -19,9 +18,7 @@ import numpy as np
 from sklearn.base import BaseEstimator, ClassifierMixin, RegressorMixin
 from sklearn.preprocessing import LabelEncoder
 
-from .callback import early_stopping as early_stopping_cb, log_evaluation, \
-    record_evaluation
-from .config import Config
+from .callback import record_evaluation
 from .dataset import Dataset
 from .engine import Booster, train
 

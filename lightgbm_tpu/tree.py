@@ -16,9 +16,9 @@ bits 2-3 = missing_type (0 none / 1 zero / 2 nan).
 from __future__ import annotations
 
 import numpy as np
-from typing import Dict, List, Optional
+from typing import Dict, List
 
-from .binning import MISSING_NONE, MISSING_ZERO, MISSING_NAN
+from .binning import MISSING_ZERO, MISSING_NAN
 
 __all__ = ["Tree"]
 

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Fallback static checker for environments without ruff.
+
+``scripts/lint_static.sh`` prefers ruff (pinned in pyproject's
+``[lint]`` extra, rules in ``[tool.ruff.lint]``); when ruff is not
+installed this covers the two highest-value rule classes with the
+stdlib only:
+
+- **syntax errors** (ruff E999): every ``.py`` file must parse;
+- **unused imports** (ruff F401): an imported name never referenced as
+  a ``Name``/attribute root, not mentioned in a string literal (which
+  covers ``__all__`` re-export lists), and not carrying ``# noqa`` on
+  its line.
+
+Deliberately conservative — it reports only what it can prove from the
+AST, so a clean ruff run implies a clean run here, never the reverse.
+"""
+
+import ast
+import os
+import sys
+
+SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "third_party",
+             "node_modules", ".claude"}
+SKIP_FILES = {"__graft_entry__.py"}     # harness-owned, not repo code
+
+
+def check_file(path: str) -> list:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: E999 syntax error: {e.msg}"]
+    lines = src.splitlines()
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imported[(a.asname or a.name).split(".")[0]] = node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for a in node.names:
+                if a.name != "*":
+                    imported[a.asname or a.name] = node.lineno
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            n = node
+            while isinstance(n, ast.Attribute):
+                n = n.value
+            if isinstance(n, ast.Name):
+                used.add(n.id)
+    out = []
+    for nm, ln in sorted(imported.items(), key=lambda kv: kv[1]):
+        if nm in used or f'"{nm}"' in src or f"'{nm}'" in src:
+            continue
+        if ln <= len(lines) and "noqa" in lines[ln - 1]:
+            continue
+        out.append(f"{path}:{ln}: F401 unused import '{nm}'")
+    return out
+
+
+def main(root: str = ".") -> int:
+    issues = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in sorted(filenames):
+            if fn.endswith(".py") and fn not in SKIP_FILES:
+                issues += check_file(os.path.join(dirpath, fn))
+    for line in issues:
+        print(line)
+    if issues:
+        print(f"_ast_lint: {len(issues)} issue(s)", file=sys.stderr)
+        return 1
+    print("_ast_lint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(*sys.argv[1:]))

@@ -20,16 +20,25 @@ Exit code 0 on success; nonzero with a diagnostic on violation.
 Run: python scripts/audit_collectives.py  (CPU-only, no hardware needed)
 """
 
+import importlib.util
 import os
 import sys
 
 
+def _load_probe():
+    """The shared probe harness (scripts/ is not a package, so load by
+    path — works both run-as-script and loaded via importlib by the
+    test suite)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "_probe", os.path.join(here, "_probe.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def _pin_virtual_mesh(n: int = 8) -> None:
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}").strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    _load_probe().pin_virtual_mesh(n)
 
 
 def run_audit(R: int = 512, F: int = 16, B: int = 16,

@@ -82,6 +82,16 @@ def rng():
     return np.random.RandomState(42)
 
 
+@pytest.fixture
+def recompile_guard():
+    """Compile-cache discipline guard (analysis/recompile_guard.py):
+    ``with recompile_guard(max_compiles=N, label=...): ...`` raises
+    RecompileError when XLA compiles more than N programs in the
+    scope."""
+    from lightgbm_tpu.analysis import RecompileGuard
+    return RecompileGuard
+
+
 # XLA:CPU in jaxlib 0.9.0 segfaults NONdeterministically while COMPILING
 # the column-sharded feature_shard_storage programs late in a long suite
 # process: three full-suite runs died with SIGSEGV (twice inside the

@@ -1,10 +1,8 @@
 """BinMapper tests (reference semantics: bin.cpp FindBin/GreedyFindBin)."""
 
 import numpy as np
-import pytest
 
-from lightgbm_tpu.binning import (BinMapper, MISSING_NAN, MISSING_NONE,
-                                  MISSING_ZERO)
+from lightgbm_tpu.binning import BinMapper, MISSING_NAN, MISSING_ZERO
 
 
 def test_few_distinct_values_get_own_bins():

@@ -1,7 +1,6 @@
 """Exclusive Feature Bundling (feature_group.h analog, TPU layout)."""
 
 import numpy as np
-import pytest
 import scipy.sparse as sp
 
 import lightgbm_tpu as lgb

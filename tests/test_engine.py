@@ -8,7 +8,7 @@ assert metric levels, round-trip models.
 import numpy as np
 import pytest
 from sklearn.datasets import load_breast_cancer, load_diabetes, load_iris
-from sklearn.metrics import (accuracy_score, log_loss, mean_squared_error,
+from sklearn.metrics import (accuracy_score, mean_squared_error,
                              roc_auc_score)
 from sklearn.model_selection import train_test_split
 

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
-from lightgbm_tpu.io import load_data_file, parse_config_file
+from lightgbm_tpu.io import load_data_file
 
 EX = "/root/reference/examples"
 # reference-data tests skip on hosts without the checkout

@@ -7,15 +7,13 @@ from conftest stand in for TPU chips.
 """
 
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from lightgbm_tpu.boosting.tree_builder import build_tree
 from lightgbm_tpu.ops.split import SplitParams
-from lightgbm_tpu.parallel.data_parallel import (DataParallelPlan,
-                                                 build_tree_dp, make_mesh)
+from lightgbm_tpu.parallel.data_parallel import DataParallelPlan
 
 from conftest import sharded_isolated as _sharded_isolated
 

@@ -1,7 +1,6 @@
 """Sequence streaming ingestion + balanced/query bagging."""
 
 import numpy as np
-import pytest
 
 import lightgbm_tpu as lgb
 
