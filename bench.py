@@ -37,6 +37,12 @@ the fused-vs-legacy valid-AUC bit-parity flag; plus
 `compile_cache_probe`: cold vs warm compile+warmup seconds through the
 persistent XLA compilation cache (subprocess-isolated). BENCH_FUSED=0 /
 BENCH_COMPILE_CACHE=0 skip.
+ISSUE 8 adds the class-batching probes (`multiclass_bench`): per-K
+(K in {1, 5, 10}) trace+compile seconds and steady ms_per_iter with
+class_batch on vs off, the fused-step jaxpr equation count and the
+number of build-phase grow loops staged per program (ONE when batched,
+K when unrolled), and the K=10 compile-time reduction ratio.
+BENCH_MULTICLASS=0 skips; BENCH_MC_ROWS / BENCH_MC_ITERS size it.
 """
 
 import json
@@ -677,6 +683,82 @@ print("DPCOMM=" + json.dumps(out))
     return out if err is None else {"dp_comm_error": err}
 
 
+def multiclass_bench() -> dict:
+    """Class-batched vs unrolled multiclass training (ISSUE 8).
+
+    For K in {1, 5, 10}: trace+compile wall seconds of the first fused
+    dispatch and steady-state ms_per_iter, under class_batch=on vs off,
+    plus the static trace measures of the acceptance criteria — fused-
+    step jaxpr equation count (program size must be ~independent of K
+    when batched) and the number of ``build``-phase grow loops staged
+    per program (ONE per iteration when batched, K unrolled otherwise;
+    counted by the TD005 walker, i.e. one histogram-dispatch group per
+    build round). K=1 runs the binary objective (one model per
+    iteration — the class axis is degenerate) as the anchor point."""
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.analysis.doctor import _fused_trace_args
+    from lightgbm_tpu.analysis.jaxpr_lint import (count_build_loops,
+                                                  iter_eqns)
+    rows = int(os.environ.get("BENCH_MC_ROWS", 1 << 14))
+    iters = int(os.environ.get("BENCH_MC_ITERS", 8))
+    f = 16
+    rng = np.random.RandomState(11)
+    X = rng.normal(size=(rows, f)).astype(np.float32)
+    out = {"mc_rows": rows, "mc_iters": iters}
+
+    for K in (1, 5, 10):
+        if K == 1:
+            y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0) \
+                .astype(np.float32)
+            obj = dict(objective="binary", metric="auc")
+        else:
+            y = (X[:, :K] + 0.5 * rng.normal(size=(rows, K))) \
+                .argmax(1).astype(np.float32)
+            obj = dict(objective="multiclass", num_class=K,
+                       metric="multi_logloss")
+        for cb in ("on", "off"):
+            params = dict(obj, num_leaves=15, learning_rate=0.1,
+                          min_data_in_leaf=20, verbosity=-1,
+                          fused_train=True, class_batch=cb)
+            ds = lgb.Dataset(X, label=y, free_raw_data=False)
+            t0 = time.time()
+            bst = lgb.train(params, ds, num_boost_round=1)
+            gb = bst._gbdt
+            gb.sync()
+            gb.scores.block_until_ready()
+            compile_s = time.time() - t0
+            if not gb.fused_ok:
+                out["mc_fused_unavailable"] = gb.fused_reason
+                return out
+            t1 = time.time()
+            for i in range(iters):
+                bst.update(defer=(i + 1 < iters))
+            gb.sync()
+            gb.scores.block_until_ready()
+            dt = time.time() - t1
+            closed = jax.make_jaxpr(gb._fused_step_entry)(
+                *_fused_trace_args(gb))
+            tag = f"k{K}_{cb}"
+            out[f"mc_compile_s_{tag}"] = round(compile_s, 2)
+            out[f"mc_ms_per_iter_{tag}"] = round(dt / iters * 1e3, 2)
+            out[f"mc_jaxpr_eqns_{tag}"] = sum(
+                1 for _ in iter_eqns(closed.jaxpr))
+            out[f"mc_build_loops_{tag}"] = count_build_loops(
+                closed.jaxpr)
+            if K == 1:
+                break       # the knob is a no-op on one model/iter
+    out["mc_batched_one_build_k10"] = out.get("mc_build_loops_k10_on") == 1
+    try:
+        out["mc_compile_reduction_k10"] = round(
+            out["mc_compile_s_k10_off"] / out["mc_compile_s_k10_on"], 2)
+        out["mc_eqns_growth_k10_vs_k1"] = round(
+            out["mc_jaxpr_eqns_k10_on"] / out["mc_jaxpr_eqns_k1_on"], 2)
+    except (KeyError, ZeroDivisionError):
+        pass
+    return out
+
+
 def compile_cache_probe() -> dict:
     """Cold vs warm compile+warmup seconds through the persistent XLA
     compilation cache (engine.enable_compilation_cache): the identical
@@ -989,6 +1071,15 @@ def main():
         except Exception as e:  # noqa: BLE001 — probes never kill bench
             print(f"dp comm ablation failed: {e}", file=sys.stderr)
 
+    mc_fields = {}
+    if os.environ.get("BENCH_MULTICLASS", "1") != "0":
+        try:
+            mc_fields = multiclass_bench()
+            print(f"multiclass class-batch bench: {mc_fields}",
+                  file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"multiclass bench failed: {e}", file=sys.stderr)
+
     cc_fields = {}
     if os.environ.get("BENCH_COMPILE_CACHE", "1") != "0":
         try:
@@ -1028,6 +1119,7 @@ def main():
         **lb_fields,
         **fused_fields,
         **dp_fields,
+        **mc_fields,
         **cc_fields,
         **serve_fields,
         **ref_fields,

@@ -56,6 +56,10 @@ CANONICAL_CONFIGS: Dict[str, Tuple[dict, dict]] = {
     "quantized": ({"use_quantized_grad": True,
                    "num_grad_quant_bins": 4}, {}),
     "categorical": ({}, {"categorical_feature": [0]}),
+    # class-batched multiclass: the fused step must stage ONE build
+    # (TD005), not num_class unrolled copies
+    "multiclass": ({"objective": "multiclass", "num_class": 3,
+                    "metric": "multi_logloss", "num_leaves": 5}, {}),
 }
 PARALLEL_MODES = ("serial", "data")
 
@@ -86,8 +90,12 @@ def _synth(config: str, *, n: int = 160, f: int = 8, seed: int = 0):
         on = rng.rand(n) < 0.5
         X[:, -2] = np.where(on, X[:, -2], 0.0)
         X[:, -1] = np.where(on, 0.0, X[:, -1])
-    y = (X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
-         + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    if config == "multiclass":
+        y = (X[:, :3] + 0.5 * rng.normal(size=(n, 3))).argmax(1) \
+            .astype(np.float32)
+    else:
+        y = (X[:, 1] + 0.5 * X[:, 2] * X[:, 3]
+             + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
     return X, y
 
 
@@ -139,7 +147,12 @@ def doctor_fused_step(bst, *, label: str = "fused_step",
         return [rep]
     args = _fused_trace_args(gb)
     closed = jax.make_jaxpr(gb._fused_step_entry)(*args)
+    # TD005 budget: one build per program when single-class or when the
+    # class-batch gate is open; a config the gate excludes (linear /
+    # forced / CEGB) legitimately unrolls, so the rule is skipped
+    build_budget = 1 if (gb.K == 1 or gb.class_batch_ok) else None
     reports.append(lint_jaxpr(closed, label=f"{label}/jaxpr",
+                              max_build_programs=build_budget,
                               allow=allow))
     if compile_hlo:
         # lower through the trainer's own jit wrapper (donation flags

@@ -24,6 +24,19 @@ hand:
   arrays alias the donated buffers, so the next in-place write corrupts
   live host views (the PR-3 corrupted-valid-metrics incident); the
   trainer pins no-donate on CPU and this rule enforces it repo-wide.
+- **TD005 class-unrolled build**: more than ``max_build_programs``
+  tree-grow ``while`` loops staged under the ``build`` profiler phase.
+  A multiclass iteration that unrolls ``for k in range(K)`` stages K
+  complete builds per program — trace size, XLA compile time and the
+  sequential kernel chain all scale O(num_class) (the regression the
+  ``class_batch`` knob removes, ISSUE 8). The class-batched build
+  stages exactly ONE (vmapped) grow loop, so callers that know the
+  gate is open pass ``max_build_programs=1``. This rule is
+  jaxpr-level only: in compiled HLO all K unrolled copies share the
+  same source location, so post-CSE ``op_name`` metadata collapses
+  them and the duplication is no longer countable (verified
+  empirically on the CPU backend — the K grow loops lower with
+  scatter-expansion metadata, not distinct build tags).
 """
 
 from __future__ import annotations
@@ -32,8 +45,8 @@ from typing import Optional, Sequence, Tuple
 
 from .report import TraceReport
 
-__all__ = ["lint_jaxpr", "iter_eqns", "CALLBACK_PRIMITIVES",
-           "DEFAULT_CONST_BYTES"]
+__all__ = ["lint_jaxpr", "iter_eqns", "count_build_loops",
+           "CALLBACK_PRIMITIVES", "DEFAULT_CONST_BYTES"]
 
 # primitive names that round-trip through the host per dispatch
 CALLBACK_PRIMITIVES = frozenset({
@@ -70,6 +83,39 @@ def iter_eqns(jaxpr):
             yield from iter_eqns(inner)
 
 
+_BUILD_SCOPE = None     # compiled lazily (module import must not need re)
+
+
+def count_build_loops(jaxpr, prefix: str = "") -> int:
+    """Number of tree-grow ``while`` loops staged under the ``build``
+    profiler phase (TD005's counting pass).
+
+    ``name_stack`` is NOT inherited by nested call jaxprs on jax 0.4.x —
+    the ``pjit``/``shard_map`` equation itself carries the scope and its
+    sub-jaxpr equations start empty — so the walk threads the
+    accumulated stack down as ``prefix``. Batching renames the scope
+    (``vmap(build)``/``transpose(build)``), hence the word-boundary
+    match rather than a prefix compare. A counted build loop's OWN
+    nested loops (blocked histogram scans etc.) belong to that build,
+    so the walk does not descend into them.
+    """
+    import re
+    global _BUILD_SCOPE
+    if _BUILD_SCOPE is None:
+        _BUILD_SCOPE = re.compile(r"\bbuild\b")
+    n = 0
+    for eqn in jaxpr.eqns:
+        stack = str(getattr(eqn.source_info, "name_stack", "") or "")
+        full = "/".join(s for s in (prefix, stack) if s)
+        if eqn.primitive.name == "while" and _BUILD_SCOPE.search(full):
+            n += 1
+            continue
+        for sub in _sub_jaxprs(eqn.params):
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            n += count_build_loops(inner, full)
+    return n
+
+
 def _const_entries(closed):
     """(index, const) for the top-level consts plus nested pjit consts
     (a closure constant can hide one jit level down)."""
@@ -88,6 +134,7 @@ def lint_jaxpr(closed, *, label: str,
                max_const_bytes: int = DEFAULT_CONST_BYTES,
                allow_callbacks: bool = False,
                backend: Optional[str] = None,
+               max_build_programs: Optional[int] = None,
                allow: Sequence[Tuple[str, str]] = ()) -> TraceReport:
     """Lint one ``ClosedJaxpr``; returns the :class:`TraceReport`.
 
@@ -95,6 +142,11 @@ def lint_jaxpr(closed, *, label: str,
     the point (debug harnesses); ``backend`` defaults to
     ``jax.default_backend()`` and gates TD004 (donation is the right
     call on accelerators — only CPU aliases host views).
+    ``max_build_programs`` enables TD005: the program may stage at most
+    that many ``build``-phase grow loops (1 for a class-batched or
+    single-class trainer; ``None`` skips the rule for programs with a
+    legitimate sequential fallback — linear trees, forced splits,
+    CEGB).
     """
     import jax
     rep = TraceReport(label=label)
@@ -143,4 +195,16 @@ def lint_jaxpr(closed, *, label: str,
                         "buffers and the next in-place write corrupts "
                         "them (gate donation on "
                         "jax.default_backend() != 'cpu')")
+
+    # TD005 — class-unrolled build
+    if max_build_programs is not None:
+        n = count_build_loops(closed.jaxpr)
+        if n > max_build_programs:
+            rep.add(
+                "TD005", "error", "build",
+                f"class-unrolled build: {n} build-phase grow loops "
+                f"staged in one program (budget {max_build_programs}); "
+                "per-class tree builds should batch over the class "
+                "axis into ONE vmapped loop (class_batch=auto), not "
+                "unroll for k in range(num_class)")
     return rep.apply_allowlist(allow)

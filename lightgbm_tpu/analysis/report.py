@@ -20,6 +20,10 @@ TD003     jaxpr     dtype widening to f64 inside traced code
 TD004     jaxpr/hlo buffer donation compiled on the CPU backend, where
                     zero-copy ``np.asarray`` views alias the donated
                     buffers (the PR-3 corrupted-metrics incident class)
+TD005     jaxpr     class-unrolled build: more ``build``-phase grow
+                    loops staged per program than the caller's budget
+                    (a multiclass iteration tracing K sequential tree
+                    builds instead of one class-batched build)
 TD101     hlo       oversized dense ``constant`` op in the compiled
                     program
 TD102     hlo       host transfer (infeed/outfeed/send/recv, callback

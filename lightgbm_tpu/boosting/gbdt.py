@@ -553,6 +553,10 @@ class GBDT:
                 (int(config.data_random_seed) * 65537 + 17) & 0x7FFFFFFF)
             self._quantize_jit = jax.jit(self._quantize_impl)
             self._renew_jit = jax.jit(self._renew_leaf_impl)
+            # class-batched legacy driver: renew all K trees in one
+            # dispatch (vmap over the class axis; see ISSUE 8)
+            self._renew_batch_jit = jax.jit(
+                jax.vmap(self._renew_leaf_impl))
 
         # feature_contri: per-feature split-gain multiplier
         # (feature_histogram.hpp:174)
@@ -607,6 +611,20 @@ class GBDT:
             if lazy is not None:
                 self._cegb_used_rows = jnp.zeros(
                     (self.train_dd.r_pad, F_used), bool)
+
+        # class-batched multiclass build (ISSUE 8): decided before the
+        # driver gate, because BOTH drivers route the per-iteration K
+        # tree builds through the batched builder when it clears
+        self.class_batch_reason = self._class_batch_reason()
+        self.class_batch_ok = not self.class_batch_reason
+        if self.class_batch_ok and self.K > 1 and self._hist_sub:
+            # the vmapped builder carries the per-leaf histogram cache
+            # PER CLASS ([K, L+1, lattice, 3]): re-gate the pool budget
+            # at K x the lattice (falls back to no-subtraction, not to
+            # the sequential path — subtraction is an optimization, the
+            # batched build stays bit-identical without it)
+            self._hist_sub = _hist_sub_gate(
+                self.K * (-(-_lattice // n_fs)))
 
         # decide the iteration driver LAST (the gate reads _cegb/_mp/...)
         self.fused_reason = self._fused_gate_reason()
@@ -948,6 +966,112 @@ class GBDT:
             return tree_arrays, row_leaf, valid_rls
         return out
 
+    # -- class-batched multiclass build (ISSUE 8) ----------------------
+
+    def _class_batch_reason(self) -> str:
+        """Why the class-batched build cannot drive this run ('' = it
+        can). Unlike the fused gate this applies to BOTH drivers: when
+        it clears, the legacy loop and the fused step each grow all K
+        per-class trees of an iteration through ONE
+        :func:`tree_builder._build_tree_class_batched` program instead
+        of K sequential builds. Anything threading per-class host state
+        between builds, or assigning tree structure sequentially, pins
+        the per-class loop."""
+        import os
+        cfg = self.config
+        env = os.environ.get("LIGHTGBM_TPU_CLASS_BATCH", "")
+        if env == "0":
+            return "LIGHTGBM_TPU_CLASS_BATCH=0"
+        mode = "on" if env == "1" else str(cfg.class_batch)
+        if mode == "off":
+            return "class_batch=off"
+        if self.K <= 1 and mode != "on":
+            # one model per iteration: nothing to batch (class_batch=on
+            # still exercises the K=1 batched path — the bench ablation
+            # and parity tests rely on that)
+            return "single model per iteration"
+        if type(self) is not GBDT:
+            return "boosting mode overrides the iteration loop"
+        if bool(cfg.linear_tree):
+            return "linear leaves solve per-class on host raw values"
+        if self._forced_splits is not None:
+            return "forced splits assign node slots sequentially"
+        if self._cegb is not None:
+            return "CEGB threads per-class model state across builds"
+        if self.plan is not None and self.plan.parallel_mode == "feature":
+            return "feature-parallel plan builds per-class"
+        if self._mp:
+            return "multi-process meshes place per-host blocks"
+        return ""
+
+    def _class_batch_keys(self, it):
+        """[K, 2] per-class builder PRNG keys — fold_in(it) then
+        fold_in(k), bit-identical to the keys the sequential loop's
+        ``_build_one_tree(.., k)`` consumes — or None when per-node
+        sampling and extra-trees are off."""
+        if self._tree_key is None:
+            return None
+        it_key = jax.random.fold_in(self._tree_key, it)
+        return jax.vmap(lambda k: jax.random.fold_in(it_key, k))(
+            jnp.arange(self.K, dtype=jnp.int32))
+
+    def _build_one_tree_batched(self, gh_k: jax.Array, fmask: jax.Array,
+                                quant_scales_k: Optional[jax.Array] = None,
+                                it=None, traced: bool = False):
+        """All K trees of one iteration in ONE class-batched build.
+        ``gh_k`` is [K, R, 3] (grad/hess/count channels per class);
+        ``quant_scales_k`` is [K, 2]. Returns (stacked TreeArrays with
+        a leading K axis, row_leaf [K, R], valid_row_leafs tuple of
+        [K, Rv]). Only reachable when :meth:`_class_batch_reason`
+        cleared, so the forced/CEGB/linear extras of
+        :meth:`_build_one_tree` never arise here."""
+        cfg = self.config
+        if it is None:
+            it = self.iter_
+        if self.plan is not None:
+            builder = functools.partial(self.plan.build_tree,
+                                        class_batched=True)
+        else:
+            builder = functools.partial(build_tree, traced=traced,
+                                        class_batched=True)
+        kw = {}
+        if quant_scales_k is not None:
+            kw["quant_scales"] = quant_scales_k
+        if self._cat_sorted_mask is not None:
+            kw["cat_sorted_mask"] = self._cat_sorted_mask
+        if self._bundle_meta is not None:
+            kw["bundle_meta"] = self._bundle_meta
+            kw["bundle_bins"] = self._bundle_bins
+        if self.plan is None and self._gain_scale is not None:
+            kw["gain_scale"] = self._gain_scale
+        mono_method = (cfg.monotone_constraints_method
+                       if self.mono_type_pf is not None else "basic")
+        leaf_batch = cfg.leaf_batch
+        if mono_method in ("intermediate", "advanced"):
+            leaf_batch = 1
+        kw["mono_method"] = mono_method
+        return builder(
+            self.train_dd.bins, gh_k, self.train_dd.row_leaf0,
+            self.num_bins_pf, self.nan_bin_pf, self.is_cat_pf, fmask,
+            num_leaves=cfg.num_leaves, leaf_batch=leaf_batch,
+            max_depth=cfg.max_depth, num_bins=self.B,
+            split_params=self.split_params,
+            hist_dtype=cfg.hist_dtype, hist_impl=cfg.hist_impl,
+            hist_sub=self._hist_sub, block_rows=self.block,
+            valid_bins=tuple(dd.bins for dd in self.valid_dd),
+            valid_row_leaf0=tuple(dd.row_leaf0 for dd in self.valid_dd),
+            mono_type_pf=self.mono_type_pf,
+            interaction_groups=self.interaction_groups,
+            rng_key=self._class_batch_keys(it),
+            feature_fraction_bynode=self._ffbn, **kw)
+
+    def _stack_gh_k(self, g, h, count_mask):
+        """[K, R, 3] batched gh for the class-batched build — the
+        per-class analog of the sequential loop's
+        ``jnp.stack([g[k], h[k], count_mask], axis=1)``."""
+        return jnp.stack([g, h, jnp.broadcast_to(count_mask, g.shape)],
+                         axis=2)
+
     def _parse_forced_splits(self, path):
         """JSON forced-split tree -> (parents, isright, feats, thrs,
         is_cat) static tuples in BFS order (ForceSplits queue
@@ -1215,8 +1339,11 @@ class GBDT:
                          it, lr):
         """The traced iteration body. Pure function of its inputs plus
         static self state; numerically identical to the legacy loop
-        (same ops, one program). Returns (scores, valid_scores,
-        [TreeArrays]*K, should_continue flag) — all on device."""
+        (same ops, one program). Returns (scores, valid_scores, trees,
+        should_continue flag) — all on device. ``trees`` is one stacked
+        TreeArrays (leading K axis) when the class-batched build drives
+        the iteration, else the per-class [TreeArrays]*K list; sync()
+        materializes both forms."""
         from .. import profiler
         cfg = self.config
         with profiler.phase("grads"):
@@ -1245,6 +1372,41 @@ class GBDT:
                 count_i8 = count_mask.astype(jnp.int8)
         new_scores = scores
         new_valid = list(valid_scores)
+        if self.class_batch_ok:
+            # class-batched build (ISSUE 8): ONE program grows all K
+            # trees — the class axis rides the leaf-slot axis through
+            # every kernel, so the staged equations and the histogram
+            # dispatches per round stop scaling with K
+            if self._quant:
+                gh_k = self._stack_gh_k(qg, qh, count_i8)
+                qsk_b = jnp.stack([q_gs, q_hs], axis=1)     # [K, 2]
+            else:
+                gh_k = self._stack_gh_k(g, h, count_mask)
+                qsk_b = None
+            with profiler.phase("build"):
+                trees_k, row_leaf_k, valid_rls_k = \
+                    self._build_one_tree_batched(
+                        gh_k, fmask, quant_scales_k=qsk_b, it=it,
+                        traced=self.plan is None)
+                if self._quant and bool(cfg.quant_train_renew_leaf):
+                    trees_k = jax.vmap(self._renew_leaf_impl)(
+                        trees_k, row_leaf_k, g_true, h_true)
+            grew_k = trees_k.num_leaves > 1                 # [K] bool
+            with profiler.phase("update"):
+                # per-class rows are independent, so the batched
+                # where() equals the sequential .at[k].set chain
+                upd = jax.vmap(self._update_score_impl,
+                               in_axes=(0, 0, 0, None))(
+                    new_scores, trees_k.leaf_values, row_leaf_k, lr)
+                new_scores = jnp.where(grew_k[:, None], upd, new_scores)
+                for vi, vrl_k in enumerate(valid_rls_k):
+                    vupd = jax.vmap(self._update_score_impl,
+                                    in_axes=(0, 0, 0, None))(
+                        new_valid[vi], trees_k.leaf_values, vrl_k, lr)
+                    new_valid[vi] = jnp.where(grew_k[:, None], vupd,
+                                              new_valid[vi])
+            return (new_scores, tuple(new_valid), trees_k,
+                    jnp.any(grew_k))
         trees = []
         grews = []
         for k in range(self.K):
@@ -1388,6 +1550,12 @@ class GBDT:
                 # reference gbdt.cpp:441-447
                 stop = True
                 break
+            if isinstance(trees_h, TreeArrays):
+                # class-batched iteration: ONE stacked TreeArrays with
+                # a leading K axis; unstack into per-class host views
+                # (zero-copy numpy slices)
+                trees_h = [jax.tree.map(lambda a: a[k], trees_h)
+                           for k in range(self.K)]
             for k, tree in enumerate(Tree.from_device_batch(
                     trees_h, bm, uf, shrink)):
                 bias = self._init_scores[k]
@@ -1450,20 +1618,47 @@ class GBDT:
         fmask = self._feature_mask()
         linear = bool(self.config.linear_tree)
         should_continue = False
-        for k in range(self.K):
+        trees_k = None
+        if self.class_batch_ok:
+            # hoisted class-batched build (ISSUE 8 satellite): ONE
+            # dispatch grows all K trees; the per-class loop below then
+            # just slices host/device views out of the stacked result —
+            # both drivers share the same build path
             if self._quant:
-                gh = jnp.stack([qg[k], qh[k], count_i8], axis=1)
-                qsk = {"quant_scales": jnp.stack([q_gs[k], q_hs[k]])}
+                gh_k = self._stack_gh_k(qg, qh, count_i8)
+                qsk_b = jnp.stack([q_gs, q_hs], axis=1)     # [K, 2]
             else:
-                gh = jnp.stack([g[k], h[k], count_mask], axis=1)
-                qsk = {}
+                gh_k = self._stack_gh_k(g, h, count_mask)
+                qsk_b = None
             with profiler.phase("build"):
-                tree_arrays, row_leaf, valid_rls = self._build_one_tree(
-                    gh, fmask, k, **qsk)
+                trees_k, row_leaf_k, valid_rls_k = \
+                    self._build_one_tree_batched(gh_k, fmask,
+                                                 quant_scales_k=qsk_b)
                 if self._quant and bool(self.config.quant_train_renew_leaf):
-                    tree_arrays = self._renew_jit(tree_arrays, row_leaf,
-                                                  g_true[k], h_true[k])
-            host = jax.tree.map(np.asarray, tree_arrays)
+                    trees_k = self._renew_batch_jit(trees_k, row_leaf_k,
+                                                    g_true, h_true)
+            trees_k_host = jax.tree.map(np.asarray, trees_k)
+        for k in range(self.K):
+            if trees_k is not None:
+                tree_arrays = jax.tree.map(lambda a: a[k], trees_k)
+                host = jax.tree.map(lambda a: a[k], trees_k_host)
+                row_leaf = row_leaf_k[k]
+                valid_rls = tuple(v[k] for v in valid_rls_k)
+            else:
+                if self._quant:
+                    gh = jnp.stack([qg[k], qh[k], count_i8], axis=1)
+                    qsk = {"quant_scales": jnp.stack([q_gs[k], q_hs[k]])}
+                else:
+                    gh = jnp.stack([g[k], h[k], count_mask], axis=1)
+                    qsk = {}
+                with profiler.phase("build"):
+                    tree_arrays, row_leaf, valid_rls = \
+                        self._build_one_tree(gh, fmask, k, **qsk)
+                    if self._quant and bool(
+                            self.config.quant_train_renew_leaf):
+                        tree_arrays = self._renew_jit(
+                            tree_arrays, row_leaf, g_true[k], h_true[k])
+                host = jax.tree.map(np.asarray, tree_arrays)
             num_leaves_trained = int(host.num_leaves)
             shrink = self.shrinkage
             tree = Tree.from_device(host, self.train_set.bin_mappers,

@@ -118,7 +118,7 @@ def _round_int(x):
 
 
 def build_tree(*args, hist_impl: str = "auto", traced: bool = False,
-               **kwargs):
+               class_batched: bool = False, **kwargs):
     """Unjitted entry: resolves ``hist_impl='auto'`` EAGERLY (the Pallas
     probe must compile outside any trace — staged into an ambient trace
     its try/except would pass vacuously) and dispatches to the jitted
@@ -127,12 +127,26 @@ def build_tree(*args, hist_impl: str = "auto", traced: bool = False,
     ``traced=True`` runs the plain (unjitted) core for callers that are
     ALREADY inside a trace — the fused boosting step of gbdt.py — so the
     build inlines into the enclosing program instead of nesting a pjit
-    call boundary."""
+    call boundary.
+
+    ``class_batched=True`` grows ALL K per-class trees of one boosting
+    iteration in one program (ISSUE 8): ``gh`` arrives [K, R, 3] (plus
+    per-class ``rng_key``/``quant_scales`` when present) and the core is
+    vmapped over the class axis — see
+    :func:`_build_tree_class_batched`. The native FFI kernels carry no
+    vmap batching rule, so the batched build remaps native -> scatter
+    (bit-identical; tests/test_histogram.py native parity)."""
+    impl = resolve_impl(hist_impl)
+    if class_batched:
+        if impl == "native":
+            impl = "scatter"
+        if traced:
+            return _build_tree_class_batched(*args, hist_impl=impl,
+                                             **kwargs)
+        return _build_tree_cb_jit(*args, hist_impl=impl, **kwargs)
     if traced:
-        return _build_tree_impl(*args, hist_impl=resolve_impl(hist_impl),
-                                **kwargs)
-    return _build_tree_jit(*args, hist_impl=resolve_impl(hist_impl),
-                           **kwargs)
+        return _build_tree_impl(*args, hist_impl=impl, **kwargs)
+    return _build_tree_jit(*args, hist_impl=impl, **kwargs)
 
 
 def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
@@ -1640,3 +1654,79 @@ _build_tree_jit = functools.partial(
                      "forced", "hist_sub", "feature_sharded",
                      "hist_merge", "n_shards"))(
     _build_tree_impl)
+
+
+def _build_tree_class_batched(bins, gh, row_leaf0, num_bins_pf,
+                              nan_bin_pf, is_cat_pf, feature_mask, *,
+                              rng_key=None, quant_scales=None,
+                              forced=None, cegb=None,
+                              hist_impl: str = "scatter", **kw):
+    """Class-batched tree growth (ISSUE 8): all K per-class trees of one
+    boosting iteration out of ONE staged program, by vmapping the
+    leaf-wise core over the class axis.
+
+    ``gh`` is [K, R, 3]; ``rng_key`` (when per-node sampling or
+    extra-trees is on) is [K, 2] per-class keys — fold_in(it) then
+    fold_in(k), the exact keys the sequential loop consumes; and
+    ``quant_scales`` (quantized training) is [K, 2]. Everything else —
+    the bin matrix, feature metadata, the root ``row_leaf0``, the valid
+    sets — is identical across classes and rides unbatched, closed over
+    by the vmapped function.
+
+    Under vmap the class axis FUSES into the existing leaf-slot axis at
+    every kernel instead of replaying the chain K times: the histogram
+    one-hot/scatter/Pallas paths each lower to a single kernel whose
+    slot dimension is K·S wide (the matmul becomes one dot_general with
+    a K batch dim — one MXU dispatch per build round), ``lax.top_k``
+    leaf selection, the partition relabel, and split finding batch
+    elementwise, and the K per-class ``lax.while_loop``s collapse into
+    ONE batched loop running max-over-classes rounds — a finished
+    class's cond goes False and its carried state freezes, which is
+    exactly the sequential fixed point (bit-parity verified in
+    tests/test_class_batch.py). Data-parallel meshes compose: the
+    histogram merge collective (psum / psum_scatter) and the
+    ``_sync_best`` winner merge batch through their vmap rules with
+    bytes-per-class unchanged.
+
+    Returns (TreeArrays with a leading K on every field, row_leaf
+    [K, R], valid_row_leafs tuple of [K, Rv] arrays).
+
+    Not batchable here (callers gate these to the sequential path):
+    forced splits and CEGB (cross-tree host state), and the native FFI
+    kernels (no vmap rule over custom calls; ``build_tree`` remaps
+    native -> scatter, which is bit-identical by the native parity
+    tests).
+    """
+    if forced is not None:
+        raise ValueError(
+            "class-batched build does not support forced splits; use "
+            "the sequential per-class path (class_batch=off)")
+    if cegb is not None:
+        raise ValueError(
+            "class-batched build does not support CEGB; use the "
+            "sequential per-class path (class_batch=off)")
+    if hist_impl == "native":
+        hist_impl = "scatter"
+
+    def one(gh_k, key_k, qs_k):
+        return _build_tree_impl(bins, gh_k, row_leaf0, num_bins_pf,
+                                nan_bin_pf, is_cat_pf, feature_mask,
+                                rng_key=key_k, quant_scales=qs_k,
+                                hist_impl=hist_impl, **kw)
+
+    return jax.vmap(
+        one, in_axes=(0,
+                      None if rng_key is None else 0,
+                      None if quant_scales is None else 0))(
+        gh, rng_key, quant_scales)
+
+
+_build_tree_cb_jit = functools.partial(
+    jax.jit,
+    static_argnames=("num_leaves", "leaf_batch", "max_depth", "num_bins",
+                     "split_params", "axis_name", "hist_dtype", "hist_impl",
+                     "block_rows", "feature_fraction_bynode",
+                     "parallel_mode", "top_k", "bundle_bins", "mono_method",
+                     "forced", "hist_sub", "feature_sharded",
+                     "hist_merge", "n_shards"))(
+    _build_tree_class_batched)

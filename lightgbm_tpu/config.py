@@ -184,6 +184,19 @@ PARAMS: Dict[str, ParamSpec] = {
                "evaluation; larger values let the fused trainer run "
                "dispatch-ahead with zero host syncs between eval "
                "points"),
+        _p("class_batch", "auto", str,
+           check=lambda v: v in ("auto", "on", "off"),
+           doc="multiclass tree construction: auto/on grow all "
+               "num_class per-class trees of an iteration in ONE "
+               "class-batched build (the class axis rides the "
+               "histogram kernel's leaf-slot axis, so trace size and "
+               "compile time stop scaling with num_class and every "
+               "histogram dispatch gets K x more MXU work); off pins "
+               "the sequential per-class loop. Configs the batched "
+               "build cannot express (linear trees, forced splits, "
+               "CEGB, feature-parallel learners) fall back "
+               "automatically; results are bit-identical either way. "
+               "LIGHTGBM_TPU_CLASS_BATCH=0/1 pins from the env"),
         _p("dp_hist_merge", "auto", str,
            check=lambda v: v in ("auto", "allreduce", "reduce_scatter"),
            doc="histogram merge collective for tree_learner=data/voting "

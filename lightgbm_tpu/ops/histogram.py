@@ -26,6 +26,20 @@ Design (TPU-first, NOT a translation):
 - A Pallas kernel generating the one-hot in VMEM (skipping the HBM
   round-trip) is the planned round-2 upgrade; this XLA formulation is the
   portable baseline and the semantics oracle for it.
+- Class batching (``class_batch``, boosting/tree_builder.py
+  ``_build_tree_class_batched``): the multiclass trainer vmaps the whole
+  build over the class axis, so these kernels run under a batching
+  trace. The matmul path's ``ghl`` gains a leading K and the contraction
+  becomes one batched matmul — effectively folding class into the
+  leaf-slot (N) dimension, hist [K, F·B, S·3] from ONE dispatch with K×
+  the MXU work per dispatch instead of K sequential calls. The scatter
+  path batches the same way (one scatter-add with a class index axis).
+  The ``native`` FFI kernel has no vmap rule — the class-batched entry
+  remaps native→scatter (bit-identical; see tests/test_histogram.py
+  native↔scatter parity). ``merge_histograms`` collectives batch too:
+  psum / psum_scatter carry [K, ...] operands in one collective, so
+  cross-chip bytes per class are unchanged while the dispatch count
+  drops K×.
 """
 
 from __future__ import annotations

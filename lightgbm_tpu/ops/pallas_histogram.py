@@ -19,6 +19,19 @@ the standard reduction pattern).
 
 Numerics match ops/histogram.py's matmul path: addends cast to
 ``hist_dtype`` (bf16 default), accumulation in f32 on the MXU.
+
+Class batching: the multiclass class-batched build
+(boosting/tree_builder.py ``_build_tree_class_batched``) vmaps the
+whole tree build, so ``pallas_call`` here lowers through its batching
+rule — ONE kernel launch whose grid gains the class axis, bit-equal to
+K sequential launches (validated in interpret mode for both the plain
+and scalar-prefetch paths). Caveat: vmap batches EVERY operand, so the
+bins matrix — logically shared across classes — is presented K× to the
+root-histogram launch ([K, R, Fc] view). XLA keeps it as a broadcast
+(no HBM copy), but the kernel's block streams read it per class: the
+root build's bins traffic is K× the sequential path's single pass.
+In-loop builds index per-class rows anyway, so only the root round
+pays; the K× MXU utilization win dominates on every measured shape.
 """
 
 from __future__ import annotations

@@ -239,7 +239,7 @@ class DataParallelPlan:
                    bundle_meta=None, bundle_bins: int = 0,
                    quant_scales=None, mono_method: str = "basic",
                    cat_sorted_mask=None, forced=None,
-                   hist_sub: bool = True):
+                   hist_sub: bool = True, class_batched: bool = False):
         return build_tree_dp(
             self.mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             is_cat_pf, feature_mask, num_leaves=num_leaves,
@@ -255,7 +255,8 @@ class DataParallelPlan:
             bundle_meta=bundle_meta, bundle_bins=bundle_bins,
             quant_scales=quant_scales, mono_method=mono_method,
             cat_sorted_mask=cat_sorted_mask, forced=forced,
-            hist_sub=hist_sub, hist_merge=self.hist_merge)
+            hist_sub=hist_sub, hist_merge=self.hist_merge,
+            class_batched=class_batched)
 
 
 class VotingParallelPlan(DataParallelPlan):
@@ -487,7 +488,8 @@ def _build_tree_fp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                      "num_bins", "split_params", "axis_name", "hist_dtype", "hist_impl",
                      "block_rows", "n_valid", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins",
-                     "mono_method", "forced", "hist_sub", "hist_merge"))
+                     "mono_method", "forced", "hist_sub", "hist_merge",
+                     "class_batched"))
 def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        is_cat_pf, feature_mask, valid_flat, extras, *,
                        num_leaves, leaf_batch, max_depth, num_bins,
@@ -495,7 +497,7 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                        n_valid, feature_fraction_bynode,
                        parallel_mode="data", top_k=20, bundle_bins=0,
                        mono_method="basic", forced=None, hist_sub=True,
-                       hist_merge="allreduce"):
+                       hist_merge="allreduce", class_batched=False):
     row = P(axis_name)
     row2 = P(axis_name, None)
     rep = P()
@@ -519,12 +521,12 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
             bundle_meta=bmeta, bundle_bins=bundle_bins,
             quant_scales=qs, mono_method=mono_method,
             cat_sorted_mask=csm, forced=forced, hist_sub=hist_sub,
-            hist_merge=hist_merge, n_shards=n_shards)
+            hist_merge=hist_merge, n_shards=n_shards,
+            class_batched=class_batched)
 
     tree_specs = jax.tree.map(lambda _: rep, TreeArrays(
         *([0] * len(TreeArrays._fields))))
     valid_in_specs = tuple([row2] * n_valid + [row] * n_valid)
-    out_valid_specs = tuple([row] * n_valid)
     # constraint metadata and PRNG key are replicated: every chip samples
     # and constrains identically, keeping the replicated argmax in sync
     extras_specs = jax.tree.map(lambda _: rep, extras)
@@ -535,11 +537,18 @@ def _build_tree_dp_jit(mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
     # prove that through the while_loop (the feature-parallel build
     # disables it for the same reason), so turn it off here too.
     rs = hist_merge == "reduce_scatter" and n_shards > 1
+    # class-batched build: gh arrives [K, R, 3] and row→leaf outputs come
+    # back [K, R] — the class axis is replicated (axis 0 of every spec
+    # below stays None), only the row axis shards. The per-class trees
+    # stack into one TreeArrays with leading K, still replicated.
+    gh_spec = P(None, axis_name, None) if class_batched else row2
+    rl_spec = P(None, axis_name) if class_batched else row
+    out_valid_specs = tuple([rl_spec] * n_valid)
     fn = _shard_map(
         step, mesh=mesh,
-        in_specs=(row2, row2, row, rep, rep, rep, rep, valid_in_specs,
+        in_specs=(row2, gh_spec, row, rep, rep, rep, rep, valid_in_specs,
                   extras_specs),
-        out_specs=(tree_specs, row, out_valid_specs),
+        out_specs=(tree_specs, rl_spec, out_valid_specs),
         check_vma=False if rs else None)
     return fn(bins, gh, row_leaf0, num_bins_pf, nan_bin_pf, is_cat_pf,
               feature_mask, valid_flat, extras)
@@ -559,13 +568,22 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
                   bundle_meta=None, bundle_bins: int = 0,
                   quant_scales=None, mono_method: str = "basic",
                   cat_sorted_mask=None, forced=None,
-                  hist_sub: bool = True, hist_merge: str = "allreduce"):
+                  hist_sub: bool = True, hist_merge: str = "allreduce",
+                  class_batched: bool = False):
     """Grow one tree with rows sharded over ``axis_name``.
 
     Same contract as :func:`..boosting.tree_builder.build_tree`; the
     returned TreeArrays are replicated (identical on every chip), the
     returned row→leaf assignments stay row-sharded. ``hist_merge``
     selects the histogram merge collective (module docstring).
+
+    ``class_batched``: grow all K per-class trees in one call — ``gh``
+    is [K, R, 3] (rows sharded on axis 1), ``rng_key``/``quant_scales``
+    carry a leading K, and the returned TreeArrays / row→leaf
+    assignments gain a leading class axis. Every collective the build
+    emits (psum histogram merge, reduce-scatter, winner pmax/pmin)
+    batches over the class axis inside ONE collective per round, so
+    wire bytes per class are unchanged while dispatch count drops K×.
     """
     valid_flat = tuple(valid_bins) + tuple(valid_row_leaf0)
     extras = (mono_type_pf, interaction_groups, rng_key, bundle_meta,
@@ -581,4 +599,5 @@ def build_tree_dp(mesh: Mesh, bins, gh, row_leaf0, num_bins_pf, nan_bin_pf,
         feature_fraction_bynode=feature_fraction_bynode,
         parallel_mode=parallel_mode, top_k=top_k,
         bundle_bins=bundle_bins, mono_method=mono_method, forced=forced,
-        hist_sub=hist_sub, hist_merge=hist_merge)
+        hist_sub=hist_sub, hist_merge=hist_merge,
+        class_batched=class_batched)

@@ -43,16 +43,27 @@ What the trace attributes, per layer:
   :func:`phase` asserts membership at annotation time, so a renamed
   phase is an immediate ValueError instead of a silent attribution
   miss in the auditors.
+
+- Wall-clock phase TOTALS: :func:`collect_phase_totals` aggregates
+  every :func:`phase` span inside a block into per-phase (total
+  seconds, span count). Span COUNTS are driver- and knob-dependent —
+  the legacy multiclass loop fires ``build`` K times per iteration
+  where the class-batched build fires it once — so comparisons
+  before/after ``class_batch`` (or across drivers) must use the
+  per-iteration totals, which is exactly what
+  :meth:`PhaseTotals.per_iteration` reports.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator, Optional
+import time
+from typing import Dict, Iterator, List, Optional, Tuple
 
 from .phases import KNOWN_PHASES
 
-__all__ = ["trace", "step_annotation", "annotate", "phase"]
+__all__ = ["trace", "step_annotation", "annotate", "phase",
+           "PhaseTotals", "collect_phase_totals"]
 
 
 @contextlib.contextmanager
@@ -99,5 +110,89 @@ def phase(name: str) -> Iterator[None]:
             f"{sorted(KNOWN_PHASES)} (lightgbm_tpu/phases.py — add new "
             "phases there so the HLO auditors keep attributing them)")
     import jax
-    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
-        yield
+    col = _ACTIVE_TOTALS
+    t0 = time.perf_counter() if col is not None else 0.0
+    try:
+        with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+            yield
+    finally:
+        if col is not None:
+            col._record(name, time.perf_counter() - t0)
+
+
+# ----------------------------------------------------------------------
+# Aggregated per-phase wall-clock totals.
+#
+# The raw spans are NOT comparable across drivers or across the
+# class_batch knob: the legacy loop fires ``build``/``update`` once per
+# class per iteration (K spans), the class-batched build exactly once,
+# and the fused step stages phases inside one dispatch (its host spans
+# measure trace/dispatch cost, not device time). Aggregating to
+# per-phase TOTALS per run keeps before/after timings comparable — the
+# sum over K unrolled spans lines up against the one batched span.
+
+_ACTIVE_TOTALS: Optional["PhaseTotals"] = None
+
+
+class PhaseTotals:
+    """Per-phase aggregate of every :func:`phase` span inside a
+    :func:`collect_phase_totals` block: total seconds and span count
+    per phase name, plus the span count of the most-hit phase per
+    ``boost_iter`` when the caller reports iterations."""
+
+    def __init__(self):
+        self._acc: Dict[str, List[float]] = {}
+
+    def _record(self, name: str, dt: float) -> None:
+        ent = self._acc.setdefault(name, [0.0, 0])
+        ent[0] += dt
+        ent[1] += 1
+
+    def total_s(self, name: str) -> float:
+        return self._acc.get(name, [0.0, 0])[0]
+
+    def count(self, name: str) -> int:
+        return int(self._acc.get(name, [0.0, 0])[1])
+
+    def items(self) -> List[Tuple[str, float, int]]:
+        return [(k, v[0], int(v[1]))
+                for k, v in sorted(self._acc.items())]
+
+    def per_iteration(self, iterations: int) -> Dict[str, dict]:
+        """{phase: {total_s, count, s_per_iter, spans_per_iter}} —
+        ``s_per_iter`` is the comparable number: the K unrolled
+        ``build`` spans of one legacy multiclass iteration and the one
+        class-batched span both aggregate to that iteration's build
+        seconds."""
+        it = max(int(iterations), 1)
+        return {k: {"total_s": v[0], "count": int(v[1]),
+                    "s_per_iter": v[0] / it,
+                    "spans_per_iter": v[1] / it}
+                for k, v in sorted(self._acc.items())}
+
+    def render(self, iterations: Optional[int] = None) -> str:
+        rows = []
+        for name, tot, cnt in self.items():
+            line = f"{name:<12} {tot * 1e3:9.2f} ms  x{cnt}"
+            if iterations:
+                line += (f"  ({tot * 1e3 / max(iterations, 1):.2f} "
+                         f"ms/iter over {iterations} iter)")
+            rows.append(line)
+        return "\n".join(rows) or "(no phase spans recorded)"
+
+
+@contextlib.contextmanager
+def collect_phase_totals() -> Iterator[PhaseTotals]:
+    """Aggregate every :func:`phase` span inside the block into a
+    :class:`PhaseTotals` (opt-in; nesting restores the outer
+    collector). Host-side wall clock: around eager dispatches (legacy
+    driver) the span covers dispatch + device wait; around staged code
+    (inside a trace) it covers trace time only."""
+    global _ACTIVE_TOTALS
+    prev = _ACTIVE_TOTALS
+    col = PhaseTotals()
+    _ACTIVE_TOTALS = col
+    try:
+        yield col
+    finally:
+        _ACTIVE_TOTALS = prev

@@ -21,6 +21,10 @@ over it — the gate must exit NON-zero, proving the rule still fires:
                        (TD103, the feature-parallel hidden-psum class)
 - ``recompile-blowout`` — a shape-unstable fn recompiling per call
                        (TD201, ladder/steady-state discipline)
+- ``class-unroll``   — a program staging one grow loop per class under
+                       the ``build`` phase, the K-unrolled multiclass
+                       iteration shape (TD005, the class_batch knob's
+                       regression class)
 
 Run: python scripts/lint_traces.py [--fast] [--seed CLASS]
 (CPU-only, no hardware needed; ``--fast`` lints one config cell and
@@ -43,7 +47,7 @@ def _load_probe():
 
 
 SEED_CLASSES = ("closure-const", "cpu-donation", "phase-collective",
-                "recompile-blowout")
+                "recompile-blowout", "class-unroll")
 
 
 def _seed_closure_const() -> list:
@@ -98,6 +102,33 @@ def _seed_recompile_blowout() -> list:
     return [g.report]
 
 
+def _seed_class_unroll() -> list:
+    """Plant the exact regression shape the class_batch work removed:
+    one ``build``-tagged grow loop traced per class (K=3 unrolled),
+    linted with the class-batched budget of ONE build per program."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu import profiler
+    from lightgbm_tpu.analysis import lint_jaxpr
+
+    def grow_one(gh_k):
+        def body(c):
+            i, acc = c
+            return i + 1, acc + gh_k.sum()
+        return jax.lax.while_loop(lambda c: c[0] < 4, body,
+                                  (jnp.int32(0), jnp.float32(0.0)))[1]
+
+    def step(gh):                       # gh [K, R]: per-class grads
+        outs = []
+        for k in range(gh.shape[0]):    # the K-unrolled anti-pattern
+            with profiler.phase("build"):
+                outs.append(grow_one(gh[k]))
+        return jnp.stack(outs)
+    closed = jax.make_jaxpr(step)(jnp.ones((3, 64), jnp.float32))
+    return [lint_jaxpr(closed, label="seed/class_unroll",
+                       max_build_programs=1)]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seed", choices=SEED_CLASSES,
@@ -122,6 +153,7 @@ def main(argv=None) -> int:
             "cpu-donation": _seed_cpu_donation,
             "phase-collective": _seed_phase_collective,
             "recompile-blowout": _seed_recompile_blowout,
+            "class-unroll": _seed_class_unroll,
         }[ns.seed]()
         for r in reports:
             print(r.render(verbose=True))
