@@ -759,6 +759,76 @@ def multiclass_bench() -> dict:
     return out
 
 
+def resilience_bench() -> dict:
+    """Fault-tolerance overhead (ISSUE 9): full-state checkpoint write/
+    restore seconds and size, wall-clock overhead of training WITH
+    periodic checkpoints + resume vs a straight run, and the NaN-guard
+    steady-state cost — host syncs per iteration between eval points
+    with ``nan_guard=rollback`` must stay 0 (the flag rides the fused
+    step's deferred outputs). BENCH_RESILIENCE=0 skips."""
+    import tempfile
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience import (read_checkpoint,
+                                         restore_training_checkpoint,
+                                         write_training_checkpoint)
+    rows = int(os.environ.get("BENCH_RESILIENCE_ROWS", 1 << 16))
+    iters = int(os.environ.get("BENCH_RESILIENCE_ITERS", 24))
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(rows, 16)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    base = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+                min_data_in_leaf=20, verbosity=-1, fused_train=True,
+                bagging_fraction=0.8, bagging_freq=2, eval_period=8)
+    out = {"resilience_rows": rows, "resilience_iters": iters}
+
+    with tempfile.TemporaryDirectory(prefix="bench_res_") as td:
+        model = os.path.join(td, "m.txt")
+        # straight run (no checkpointing) — the overhead denominator
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        t0 = time.time()
+        bst = lgb.train(dict(base, output_model=model), ds,
+                        num_boost_round=iters)
+        bst._gbdt.sync()
+        plain_s = time.time() - t0
+
+        # checkpoint write/read/restore on the trained state
+        ckpt = model + ".ckpt_iter_bench"
+        t0 = time.time()
+        write_training_checkpoint(ckpt, bst, [], begin_iteration=0,
+                                  end_iteration=iters, params=base)
+        out["ckpt_write_s"] = round(time.time() - t0, 3)
+        out["ckpt_mb"] = round(os.path.getsize(ckpt) / 2**20, 2)
+        t0 = time.time()
+        s2, a2, t2 = read_checkpoint(ckpt)
+        restore_training_checkpoint(bst, [], s2, a2, t2)
+        out["ckpt_restore_s"] = round(time.time() - t0, 3)
+
+        # checkpointed run + mid-flight resume vs the straight run
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        t0 = time.time()
+        params = dict(base, output_model=model, resume="auto",
+                      snapshot_freq=8, nan_guard="rollback")
+        lgb.train(params, ds, num_boost_round=iters // 2)._gbdt.sync()
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst2 = lgb.train(params, ds, num_boost_round=iters)
+        gb = bst2._gbdt
+        gb.sync()
+        resumed_s = time.time() - t0
+        out["resume_overhead_ms"] = round((resumed_s - plain_s) * 1e3, 1)
+
+        # NaN-guard steady-state: syncs between eval points stay 0
+        before = gb.host_sync_count
+        n_quiet = 0
+        for i in range(bst2.current_iteration(),
+                       bst2.current_iteration() + 7):
+            bst2.update(defer=True)
+            n_quiet += 1
+        out["nan_guard_host_syncs_per_iter"] = round(
+            (gb.host_sync_count - before) / max(1, n_quiet), 3)
+        gb.sync()
+    return out
+
+
 def compile_cache_probe() -> dict:
     """Cold vs warm compile+warmup seconds through the persistent XLA
     compilation cache (engine.enable_compilation_cache): the identical
@@ -1080,6 +1150,14 @@ def main():
         except Exception as e:  # noqa: BLE001 — probes never kill bench
             print(f"multiclass bench failed: {e}", file=sys.stderr)
 
+    res_fields = {}
+    if os.environ.get("BENCH_RESILIENCE", "1") != "0":
+        try:
+            res_fields = resilience_bench()
+            print(f"resilience bench: {res_fields}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"resilience bench failed: {e}", file=sys.stderr)
+
     cc_fields = {}
     if os.environ.get("BENCH_COMPILE_CACHE", "1") != "0":
         try:
@@ -1120,6 +1198,7 @@ def main():
         **fused_fields,
         **dp_fields,
         **mc_fields,
+        **res_fields,
         **cc_fields,
         **serve_fields,
         **ref_fields,

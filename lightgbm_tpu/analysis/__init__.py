@@ -20,7 +20,7 @@ Three passes, one report model:
 """
 
 from .report import Finding, TraceReport, merge_errors  # noqa: F401
-from .jaxpr_lint import lint_jaxpr  # noqa: F401
+from .jaxpr_lint import lint_deferred_guard, lint_jaxpr  # noqa: F401
 from .hlo_lint import lint_hlo  # noqa: F401
 from .hlo_walk import (HloOp, COLLECTIVE_KINDS, parse_ops,  # noqa: F401
                        parse_collective_ops, input_output_aliases,
@@ -31,7 +31,7 @@ from .doctor import run_doctor, doctor_main, CANONICAL_CONFIGS  # noqa: F401
 
 __all__ = [
     "Finding", "TraceReport", "merge_errors",
-    "lint_jaxpr", "lint_hlo",
+    "lint_jaxpr", "lint_deferred_guard", "lint_hlo",
     "HloOp", "COLLECTIVE_KINDS", "parse_ops", "parse_collective_ops",
     "input_output_aliases", "lower_hlo",
     "RecompileGuard", "RecompileError", "cache_size",

@@ -41,7 +41,7 @@ import numpy as np
 from ..phases import COLLECTIVE_PHASES
 from .hlo_lint import lint_hlo
 from .hlo_walk import lower_hlo
-from .jaxpr_lint import lint_jaxpr
+from .jaxpr_lint import lint_deferred_guard, lint_jaxpr
 from .recompile_guard import cache_size
 from .report import TraceReport, merge_errors
 
@@ -60,6 +60,11 @@ CANONICAL_CONFIGS: Dict[str, Tuple[dict, dict]] = {
     # (TD005), not num_class unrolled copies
     "multiclass": ({"objective": "multiclass", "num_class": 3,
                     "metric": "multi_logloss", "num_leaves": 5}, {}),
+    # armed NaN guard over the RNG-stream-sensitive bagging config: the
+    # divergence flag must stay a deferred program output (TD006), not
+    # an eager per-iteration host check
+    "nan_guard": ({"nan_guard": "rollback", "bagging_fraction": 0.8,
+                   "bagging_freq": 2, "bagging_seed": 7}, {}),
 }
 PARALLEL_MODES = ("serial", "data")
 
@@ -154,6 +159,12 @@ def doctor_fused_step(bst, *, label: str = "fused_step",
     reports.append(lint_jaxpr(closed, label=f"{label}/jaxpr",
                               max_build_programs=build_budget,
                               allow=allow))
+    if getattr(gb, "_nan_guard", "off") != "off":
+        # TD006: armed guard — the finite flag must be a deferred
+        # program output next to the no-split stop flag
+        reports.append(lint_deferred_guard(
+            closed, label=f"{label}/guard", expect_flags=2,
+            allow=allow))
     if compile_hlo:
         # lower through the trainer's own jit wrapper (donation flags
         # and all), not a fresh jax.jit — TD004 must see what dispatch

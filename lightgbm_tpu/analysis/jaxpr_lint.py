@@ -24,6 +24,16 @@ hand:
   arrays alias the donated buffers, so the next in-place write corrupts
   live host views (the PR-3 corrupted-valid-metrics incident); the
   trainer pins no-donate on CPU and this rule enforces it repo-wide.
+- **TD006 eager guard flag**: the fused step's deferred stop/NaN flags
+  missing from the program outputs. The no-split stop AND the numeric-
+  divergence guard (``nan_guard``, the resilience PR) are deferred
+  device booleans read in ONE batched ``device_get`` at sync points; an
+  implementation that checks either one eagerly (``bool(flag)`` /
+  ``float(x)`` inside the dispatch path) collapses dispatch-ahead to a
+  host sync per iteration. The rule asserts the traced step exposes the
+  expected number of scalar-bool outvars — a flag that was synced
+  eagerly no longer appears as a program output.
+
 - **TD005 class-unrolled build**: more than ``max_build_programs``
   tree-grow ``while`` loops staged under the ``build`` profiler phase.
   A multiclass iteration that unrolls ``for k in range(K)`` stages K
@@ -45,8 +55,9 @@ from typing import Optional, Sequence, Tuple
 
 from .report import TraceReport
 
-__all__ = ["lint_jaxpr", "iter_eqns", "count_build_loops",
-           "CALLBACK_PRIMITIVES", "DEFAULT_CONST_BYTES"]
+__all__ = ["lint_jaxpr", "lint_deferred_guard", "iter_eqns",
+           "count_build_loops", "CALLBACK_PRIMITIVES",
+           "DEFAULT_CONST_BYTES"]
 
 # primitive names that round-trip through the host per dispatch
 CALLBACK_PRIMITIVES = frozenset({
@@ -207,4 +218,39 @@ def lint_jaxpr(closed, *, label: str,
                 "per-class tree builds should batch over the class "
                 "axis into ONE vmapped loop (class_batch=auto), not "
                 "unroll for k in range(num_class)")
+    return rep.apply_allowlist(allow)
+
+
+def lint_deferred_guard(closed, *, label: str,
+                        expect_flags: int = 2,
+                        allow: Sequence[Tuple[str, str]] = ()
+                        ) -> TraceReport:
+    """TD006: the fused step's deferred flags must be PROGRAM OUTPUTS.
+
+    The no-split stop and the NaN guard each ride the dispatch as a
+    scalar-bool outvar, read together in sync()'s one batched
+    ``device_get``. Counting scalar-bool outputs of the traced step
+    catches the regression where a guard implementation syncs its flag
+    eagerly (``bool(ok)`` in the dispatch path): the flag then never
+    reaches the program interface, dispatch-ahead collapses to one
+    host round-trip per iteration, and ``host_syncs_per_iter`` between
+    eval points stops being 0.
+    """
+    rep = TraceReport(label=label)
+    n = 0
+    for var in closed.jaxpr.outvars:
+        aval = getattr(var, "aval", None)
+        if aval is None:
+            continue
+        if getattr(aval, "shape", None) == () \
+                and str(getattr(aval, "dtype", "")) == "bool":
+            n += 1
+    if n < expect_flags:
+        rep.add(
+            "TD006", "error", "deferred_flags",
+            f"{n} scalar-bool program output(s), expected "
+            f">= {expect_flags} (no-split stop + nan_guard finite "
+            "flag); a guard checked eagerly inside the dispatch path "
+            "drops its flag from the program interface and forces a "
+            "host sync per iteration")
     return rep.apply_allowlist(allow)

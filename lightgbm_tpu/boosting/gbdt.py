@@ -524,6 +524,13 @@ class GBDT:
         self._fused_jit = None
         self.host_sync_count = 0
 
+        # numeric-divergence guard (resilience subsystem): the fused
+        # step ALWAYS computes the finiteness flag (one program shape
+        # regardless of policy — the flag is ignored when off, so the
+        # default stays bit-identical); sync()/the legacy driver act on
+        # it only when the policy arms it
+        self._nan_guard = str(getattr(config, "nan_guard", "off"))
+
         # quantized-gradient training (GradientDiscretizer,
         # gradient_discretizer.hpp:22/.cpp:55-140): gradients are
         # stochastically rounded onto an int8 grid and the histogram runs
@@ -1340,10 +1347,14 @@ class GBDT:
         """The traced iteration body. Pure function of its inputs plus
         static self state; numerically identical to the legacy loop
         (same ops, one program). Returns (scores, valid_scores, trees,
-        should_continue flag) — all on device. ``trees`` is one stacked
-        TreeArrays (leading K axis) when the class-batched build drives
-        the iteration, else the per-class [TreeArrays]*K list; sync()
-        materializes both forms."""
+        should_continue flag, finite flag) — all on device. ``trees`` is
+        one stacked TreeArrays (leading K axis) when the class-batched
+        build drives the iteration, else the per-class [TreeArrays]*K
+        list; sync() materializes both forms. The finite flag is the
+        NaN guard's deferred device check (same mechanism as the
+        no-split stop): NaN gradients produce -inf gains and a
+        no-split tree, so without the explicit g/h check divergence
+        would masquerade as a clean early stop."""
         from .. import profiler
         cfg = self.config
         with profiler.phase("grads"):
@@ -1370,6 +1381,7 @@ class GBDT:
                 qg, qh, q_gs, q_hs = self._quantize_impl(
                     g, h, jax.random.fold_in(self._quant_key, it))
                 count_i8 = count_mask.astype(jnp.int8)
+        finite = jnp.all(jnp.isfinite(g)) & jnp.all(jnp.isfinite(h))
         new_scores = scores
         new_valid = list(valid_scores)
         if self.class_batch_ok:
@@ -1405,8 +1417,9 @@ class GBDT:
                         new_valid[vi], trees_k.leaf_values, vrl_k, lr)
                     new_valid[vi] = jnp.where(grew_k[:, None], vupd,
                                               new_valid[vi])
+            finite = finite & jnp.all(jnp.isfinite(new_scores))
             return (new_scores, tuple(new_valid), trees_k,
-                    jnp.any(grew_k))
+                    jnp.any(grew_k), finite)
         trees = []
         grews = []
         for k in range(self.K):
@@ -1439,7 +1452,8 @@ class GBDT:
             trees.append(tree_arrays)
             grews.append(grew)
         cont = jnp.any(jnp.stack(grews))
-        return new_scores, tuple(new_valid), trees, cont
+        finite = finite & jnp.all(jnp.isfinite(new_scores))
+        return new_scores, tuple(new_valid), trees, cont, finite
 
     def _fused_data_args(self):
         """The large per-instance device arrays the fused step reads,
@@ -1515,14 +1529,14 @@ class GBDT:
             donate = (0, 1) if jax.default_backend() != "cpu" else ()
             self._fused_jit = jax.jit(self._fused_step_entry,
                                       donate_argnums=donate)
-        scores, valid_scores, trees, cont = self._fused_jit(
+        scores, valid_scores, trees, cont, ok = self._fused_jit(
             self.scores, tuple(self.valid_scores), mask, fmask,
             jnp.asarray(it, jnp.int32),
             jnp.asarray(self.shrinkage, jnp.float32),
             self._fused_data_args())
         self.scores = scores
         self.valid_scores = list(valid_scores)
-        self._pending.append((it, float(self.shrinkage), trees, cont))
+        self._pending.append((it, float(self.shrinkage), trees, cont, ok))
         self.iter_ += 1
 
     def sync(self) -> bool:
@@ -1536,14 +1550,24 @@ class GBDT:
         if not self._pending:
             return False
         pending, self._pending = self._pending, []
-        host = jax.device_get([(trees, cont)
-                               for (_, _, trees, cont) in pending])
+        host = jax.device_get([(trees, cont, ok)
+                               for (_, _, trees, cont, ok) in pending])
         self.host_sync_count += 1
         bm = self.train_set.bin_mappers
         uf = self.train_set.used_features
         stop = False
         kept = 0
-        for (it, shrink, _, _), (trees_h, cont) in zip(pending, host):
+        for (it, shrink, _, _, _), (trees_h, cont, ok) in zip(pending,
+                                                              host):
+            if self._nan_guard != "off" and not bool(ok):
+                # divergence check BEFORE the no-split stop: NaN grads
+                # build a no-split tree, which would otherwise read as
+                # a clean early stop. iter_ rewinds to the last good
+                # iteration so a checkpoint restore / re-raise sees a
+                # consistent counter.
+                from ..resilience.guards import NumericDivergenceError
+                self.iter_ = pending[0][0] + kept
+                raise NumericDivergenceError(it)
             if not bool(cont) and it > 0:
                 # drop the no-op iteration (and its dispatch-ahead
                 # successors, which trained on unchanged scores),
@@ -1585,6 +1609,7 @@ class GBDT:
         syncs on its ``eval_period`` cadence). Custom gradients and
         fallback configs run the legacy loop eagerly either way.
         """
+        self._maybe_chaos_poison()
         if gradients is not None or hessians is not None \
                 or not self.fused_ok:
             if self.sync():        # drain any deferred work first
@@ -1594,6 +1619,29 @@ class GBDT:
         if defer:
             return None
         return self.sync()
+
+    def _maybe_chaos_poison(self) -> None:
+        """Fault-injection hook (scripts/chaos_train.py): when armed via
+        LIGHTGBM_TPU_CHAOS_POISON_ITER, overwrite one score entry with
+        NaN before the matching iteration dispatches — the NaN
+        propagates through the gradients so the divergence guard must
+        catch it. A marker file (LIGHTGBM_TPU_CHAOS_POISON_ONCE) makes
+        the fault transient: the rollback policy's re-run then
+        succeeds. Inert (two env reads) outside the harness."""
+        import os
+        it_s = os.environ.get("LIGHTGBM_TPU_CHAOS_POISON_ITER")
+        if it_s is None or self.iter_ != int(it_s):
+            return
+        marker = os.environ.get("LIGHTGBM_TPU_CHAOS_POISON_ONCE")
+        if marker:
+            if os.path.exists(marker):
+                return      # already fired once; fault was transient
+            with open(marker, "w") as f:
+                f.write("poisoned\n")
+        poisoned = np.asarray(self.scores).copy()
+        poisoned[0, 0] = np.nan
+        self.scores = (self.plan.shard_scores(poisoned)
+                       if self.plan is not None else jnp.asarray(poisoned))
 
     def _train_one_iter_legacy(self,
                                gradients: Optional[np.ndarray] = None,
@@ -1614,6 +1662,14 @@ class GBDT:
                 qg, qh, q_gs, q_hs = self._quantize_jit(
                     g, h, jax.random.fold_in(self._quant_key, self.iter_))
                 count_i8 = count_mask.astype(jnp.int8)
+        if self._nan_guard != "off":
+            # eager form of the fused step's deferred finite flag (the
+            # legacy loop syncs every iteration anyway); checked BEFORE
+            # the build so a corrupt tree is never appended
+            if not (bool(jnp.all(jnp.isfinite(g)))
+                    and bool(jnp.all(jnp.isfinite(h)))):
+                from ..resilience.guards import NumericDivergenceError
+                raise NumericDivergenceError(self.iter_)
 
         fmask = self._feature_mask()
         linear = bool(self.config.linear_tree)
@@ -1776,6 +1832,79 @@ class GBDT:
             if self.keep_device_trees:
                 self.device_trees.pop()
         self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    # full-state checkpoint capture/restore (resilience subsystem)
+    # ------------------------------------------------------------------
+    def training_state(self) -> Tuple[dict, dict]:
+        """Capture the complete mutable training state for a
+        bit-identical-resume checkpoint: iteration counter, the two host
+        RNG streams, the device score accumulators, and the cached
+        bagging mask. Drains pending fused iterations first, so after
+        this call ``iter_`` == materialized trees == host-RNG draws
+        consumed — the invariant resume depends on. (Device PRNG keys
+        are stateless ``fold_in(key, it)`` derivations, nothing to
+        capture.)"""
+        self.sync()
+        if self.plan is not None and self.plan.multi_process:
+            raise NotImplementedError(
+                "full-state checkpoints are single-process only: "
+                "multi-process meshes place per-host score blocks")
+        if self.keep_device_trees:
+            raise NotImplementedError(
+                "full-state checkpoints do not capture per-tree device "
+                "state (boosting=dart/goss with kept device trees); "
+                "disable resume for this boosting mode")
+        from ..resilience.checkpoint import _rng_state_to_json
+        state = {
+            "iter": int(self.iter_),
+            "rng_bagging": _rng_state_to_json(
+                self._rng_bagging.get_state()),
+            "rng_feature": _rng_state_to_json(
+                self._rng_feature.get_state()),
+            "has_bag_mask": self._bag_mask is not None,
+        }
+        arrays = {"scores": np.asarray(self.scores)}
+        for vi, vs in enumerate(self.valid_scores):
+            arrays[f"valid_scores_{vi}"] = np.asarray(vs)
+        if self._bag_mask is not None:
+            arrays["bag_mask"] = np.asarray(self._bag_mask)
+        return state, arrays
+
+    def load_training_state(self, state: dict, arrays: dict,
+                            trees: List[Tree]) -> None:
+        """Restore a :meth:`training_state` capture into this live
+        instance. Trees replace ``models`` IN PLACE so the engine's
+        ``Booster._trees`` alias keeps pointing at the live list; score
+        arrays are re-placed through the parallel plan's sharding so
+        mesh runs restore onto the same device layout they saved
+        from."""
+        if self.plan is not None and self.plan.multi_process:
+            raise NotImplementedError(
+                "full-state checkpoint restore is single-process only")
+        from ..resilience.checkpoint import _rng_state_from_json
+        self._pending.clear()
+        self.models[:] = trees
+        self.iter_ = int(state["iter"])
+        self._rng_bagging.set_state(
+            _rng_state_from_json(state["rng_bagging"]))
+        self._rng_feature.set_state(
+            _rng_state_from_json(state["rng_feature"]))
+
+        def _place_scores(a):
+            return (self.plan.shard_scores(a) if self.plan is not None
+                    else jnp.asarray(a))
+        self.scores = _place_scores(arrays["scores"])
+        self.valid_scores = [
+            _place_scores(arrays[f"valid_scores_{vi}"])
+            for vi in range(len(self.valid_scores))]
+        if state.get("has_bag_mask") and "bag_mask" in arrays:
+            m = arrays["bag_mask"]
+            self._bag_mask = (self.plan.shard_rows(m)
+                              if self.plan is not None
+                              else jnp.asarray(m))
+        else:
+            self._bag_mask = None
 
     # ------------------------------------------------------------------
     def _host_feature_bins(self, bins_h: np.ndarray) -> np.ndarray:

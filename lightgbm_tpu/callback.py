@@ -75,6 +75,25 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]):
         for name, metric, value, _ in env.evaluation_result_list:
             eval_result[name][metric].append(value)
     _callback.order = 20
+
+    # full-state checkpoint hooks (resilience/checkpoint.py): the eval
+    # history must travel with the checkpoint or a resumed run returns
+    # a truncated eval_result dict
+    def _get_state():
+        return {name: {metric: list(vals)
+                       for metric, vals in metrics.items()}
+                for name, metrics in eval_result.items()}
+
+    def _set_state(state):
+        eval_result.clear()
+        for name, metrics in state.items():
+            od = collections.OrderedDict()
+            for metric, vals in metrics.items():
+                od[metric] = list(vals)
+            eval_result[name] = od
+    _callback.get_state = _get_state
+    _callback.set_state = _set_state
+    _callback.state_key = "record_evaluation"
     return _callback
 
 
@@ -103,8 +122,14 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     best_iter: List[int] = []
     best_score_list: List[list] = []
     cmp_op: List[Callable] = []
+    bigger_flags: List[bool] = []   # serializable cmp_op provenance
     enabled = [True]
     first_metric = [""]
+
+    def _make_cmp(bigger: bool) -> Callable:
+        if bigger:
+            return lambda x, y: x > y + min_delta
+        return lambda x, y: x < y - min_delta
 
     def _init(env: CallbackEnv):
         enabled[0] = not any(
@@ -125,12 +150,9 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
         for name, metric, _, bigger in env.evaluation_result_list:
             best_iter.append(0)
             best_score_list.append(None)
-            if bigger:
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y + min_delta)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y - min_delta)
+            bigger_flags.append(bool(bigger))
+            best_score.append(float("-inf") if bigger else float("inf"))
+            cmp_op.append(_make_cmp(bigger))
 
     def _final_iteration_check(env, eval_name_splitted, i):
         if env.iteration == env.end_iteration - 1:
@@ -169,4 +191,38 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     # "training" skip above), so engine.train may skip the train-set
     # eval when early stopping is the only metric consumer
     _callback.consumes_train_metrics = False
+
+    # full-state checkpoint hooks: without them a resumed run restarts
+    # the patience window and stops at a different iteration than the
+    # uninterrupted one
+    def _get_state():
+        return {
+            "initialized": bool(best_score),
+            "enabled": enabled[0],
+            "first_metric": first_metric[0],
+            "bigger_flags": list(bigger_flags),
+            "best_score": list(best_score),
+            "best_iter": list(best_iter),
+            "best_score_list": [
+                None if bsl is None else [list(e) for e in bsl]
+                for bsl in best_score_list],
+        }
+
+    def _set_state(state):
+        del best_score[:], best_iter[:], best_score_list[:]
+        del cmp_op[:], bigger_flags[:]
+        enabled[0] = state["enabled"]
+        first_metric[0] = state["first_metric"]
+        if not state["initialized"]:
+            return
+        bigger_flags.extend(bool(b) for b in state["bigger_flags"])
+        best_score.extend(state["best_score"])
+        best_iter.extend(int(i) for i in state["best_iter"])
+        best_score_list.extend(
+            None if bsl is None else [tuple(e) for e in bsl]
+            for bsl in state["best_score_list"])
+        cmp_op.extend(_make_cmp(b) for b in bigger_flags)
+    _callback.get_state = _get_state
+    _callback.set_state = _set_state
+    _callback.state_key = "early_stopping"
     return _callback

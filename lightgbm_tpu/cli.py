@@ -151,10 +151,19 @@ def run(params: Dict[str, str]) -> int:
         callbacks = []
         if int(cfg.metric_freq) > 0 and int(cfg.verbosity) >= 0:
             callbacks.append(lgb.log_evaluation(int(cfg.metric_freq)))
-        booster = lgb.train(
-            engine_params, train, num_boost_round=int(cfg.num_iterations),
-            valid_sets=valid_sets, valid_names=valid_names,
-            callbacks=callbacks)
+        from .resilience import TrainingPreempted
+        try:
+            booster = lgb.train(
+                engine_params, train,
+                num_boost_round=int(cfg.num_iterations),
+                valid_sets=valid_sets, valid_names=valid_names,
+                callbacks=callbacks)
+        except TrainingPreempted as e:
+            # graceful preemption: the final checkpoint is on disk;
+            # exit 0 so supervisors treat the eviction as clean
+            print(f"Training preempted: {e}")
+            print("Re-run with resume=auto to continue bit-identically.")
+            return 0
         booster.save_model(cfg.output_model)
         print(f"Finished training; model written to {cfg.output_model}")
         return 0
@@ -218,8 +227,9 @@ def main(argv: Optional[List[str]] = None) -> int:
               "[port=8080 ...]\n"
               "       python -m lightgbm_tpu trace-doctor [--config ...]"
               " [--mode ...]\n"
+              "       python -m lightgbm_tpu chaos [--fast] [--cell ...]\n"
               "tasks: train | predict | refit | save_binary | serve | "
-              "trace-doctor")
+              "trace-doctor | chaos")
         return 0
     # `python -m lightgbm_tpu serve model=...` — subcommand spelling of
     # task=serve (the reference CLI is key=value only; serve is ours)
@@ -230,6 +240,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if argv[0] in ("trace-doctor", "trace_doctor"):
         from .analysis.doctor import doctor_main
         return doctor_main(argv[1:])
+    # `chaos` — the fault-injection harness (scripts/chaos_train.py):
+    # kills training at arbitrary iterations, corrupts checkpoints,
+    # poisons gradients, and asserts bit-identical recovery
+    if argv[0] == "chaos":
+        import importlib.util
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(os.path.dirname(here), "scripts",
+                            "chaos_train.py")
+        if not os.path.exists(path):
+            raise SystemExit(
+                "chaos harness not found (scripts/chaos_train.py ships "
+                "with the repo checkout, not the installed package)")
+        spec = importlib.util.spec_from_file_location("chaos_train", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.main(argv[1:])
     return run(_parse_argv(argv))
 
 

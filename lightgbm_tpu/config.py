@@ -339,6 +339,33 @@ PARAMS: Dict[str, ParamSpec] = {
            aliases=("model_output", "model_out")),
         _p("saved_feature_importance_type", 0, int),
         _p("snapshot_freq", -1, int, aliases=("save_period",)),
+        _p("snapshot_keep", 3, int, check=lambda v: v >= 1,
+           doc="retention for snapshot_freq artifacts: keep only the "
+               "newest N *.snapshot_iter_/*.ckpt_iter_ files per "
+               "output_model so long runs stop accumulating unbounded "
+               "snapshots"),
+        # -- fault tolerance (resilience subsystem, no reference analog)
+        _p("resume", "off", str,
+           check=lambda v: v in ("off", "auto") or bool(v),
+           doc="preemption-safe resume: auto scans output_model for the "
+               "newest VALID *.ckpt_iter_ full-state checkpoint "
+               "(corrupt/truncated files are rejected by checksum and "
+               "the previous one used) and continues bit-identically to "
+               "an uninterrupted run; a path resumes from that exact "
+               "checkpoint; off (default) disables checkpoint writes "
+               "and scanning. Enabling resume also arms the "
+               "SIGTERM/SIGINT preemption handler: the first signal "
+               "drains pending device work, writes a final checkpoint, "
+               "and exits cleanly"),
+        _p("nan_guard", "off", str,
+           check=lambda v: v in ("off", "raise", "rollback"),
+           doc="sync-free NaN/Inf detection on gradients/scores, "
+               "carried through the fused step as a deferred device "
+               "flag next to the no-split stop (zero extra host syncs "
+               "between eval points): raise surfaces "
+               "NumericDivergenceError; rollback restores the newest "
+               "valid checkpoint and re-runs with a logged incident "
+               "(requires resume != off); off skips the check"),
         _p("linear_tree", False, bool, aliases=("linear_trees",)),
         _p("output_result", "LightGBM_predict_result.txt", str,
            aliases=("predict_result", "prediction_result", "predict_name",

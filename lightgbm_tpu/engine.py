@@ -873,9 +873,14 @@ class Booster:
             importance_type = ("gain" if int(Config(self.params)
                                .saved_feature_importance_type) == 1
                                else "split")
-        with open(filename, "w") as f:
-            f.write(self.model_to_string(num_iteration, start_iteration,
-                                         importance_type))
+        # atomic write (tmp + fsync + os.replace): a SIGKILL mid-write
+        # must never leave a truncated model under the final name that
+        # init_model/resume then half-parses
+        from .resilience import atomic_write_text
+        atomic_write_text(filename,
+                          self.model_to_string(num_iteration,
+                                               start_iteration,
+                                               importance_type))
         return self
 
     def model_from_string(self, model_str: str):
@@ -1340,49 +1345,173 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     # predict()'s _all_trees() slice depends on this.
     init_iteration = booster.current_iteration()
     end_iteration = init_iteration + num_boost_round
-    for i in range(init_iteration, end_iteration):
-        env_before = CallbackEnv(booster, params, i, init_iteration,
-                                 end_iteration, None)
-        for cb in callbacks_before:
-            cb(env_before)
-        snapshot_here = (cfg.snapshot_freq > 0
-                         and (i + 1) % cfg.snapshot_freq == 0)
-        # sync points: every eval_period-th iteration, the final one,
-        # and snapshot boundaries. Between them the fused trainer
-        # defers — trees stay on device, no host syncs.
-        sync_here = ((i - init_iteration + 1) % eval_period == 0
-                     or i == end_iteration - 1 or snapshot_here)
-        # step marker for jax.profiler traces (profiler.trace) — the
-        # per-iteration timing hook of gbdt.cpp:246-249
-        with profiler.step_annotation("boost_iter", step_num=i):
-            stop = booster.update(fobj=fobj, defer=not sync_here)
-        if not (sync_here or stop):
-            continue
-        evals = []
-        need_eval = bool(eval_consumers) or cfg.early_stopping_round > 0
-        if need_eval:
-            with profiler.phase("eval"):
-                if cfg.is_provide_training_metric and (
-                        train_metric_consumers or not callbacks_after):
-                    evals.extend(booster.eval_train(feval))
-                evals.extend(booster.eval_valid(feval))
-        env = CallbackEnv(booster, params, i, init_iteration, end_iteration,
-                          evals)
-        try:
-            for cb in callbacks_after:
-                cb(env)
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for name, metric, value, _ in (e.best_score or []):
-                booster.best_score.setdefault(name, {})[metric] = value
-            break
-        if snapshot_here:
-            # periodic checkpoint (gbdt.cpp:250-254): full model text,
-            # resumable via init_model
-            booster.save_model(
-                f"{cfg.output_model}.snapshot_iter_{i + 1}")
-        if stop:
-            break
+
+    # -- fault tolerance (resilience subsystem) ----------------------
+    from .resilience import (
+        NumericDivergenceError, PreemptionGuard, TrainingPreempted,
+        checkpoint_path, config_fingerprint, find_resume_checkpoint,
+        prune_numbered, read_checkpoint, restore_training_checkpoint,
+        write_training_checkpoint)
+    resume = str(cfg.resume)
+    resume_on = resume != "off"
+    nan_guard = str(cfg.nan_guard)
+    fingerprint = config_fingerprint(params) if resume_on else None
+    # cadence_base anchors the eval/snapshot cadence. A resumed run
+    # must reuse the ORIGINAL run's anchor — recomputing it from the
+    # restored iteration would shift every sync point and early
+    # stopping would observe different metrics than the uninterrupted
+    # run.
+    cadence_base = init_iteration
+
+    def _restore(state, arrays, texts):
+        nonlocal cadence_base, end_iteration
+        booster._ensure_gbdt()
+        restore_training_checkpoint(booster, callbacks, state, arrays,
+                                    texts)
+        cadence_base = int(state.get("begin_iteration", cadence_base))
+        rec_end = int(state.get("end_iteration", end_iteration))
+        if rec_end != end_iteration:
+            log.info(f"resume: continuing to the original run's "
+                     f"end_iteration={rec_end} "
+                     f"(num_boost_round ignored)")
+            end_iteration = rec_end
+
+    def _write_ckpt(iteration: int) -> str:
+        path = checkpoint_path(cfg.output_model, iteration)
+        write_training_checkpoint(
+            path, booster, callbacks, begin_iteration=cadence_base,
+            end_iteration=end_iteration, params=params)
+        prune_numbered(cfg.output_model + ".ckpt_iter_",
+                       cfg.snapshot_keep)
+        return path
+
+    if resume_on:
+        if init_model is not None:
+            raise ValueError(
+                "resume cannot be combined with init_model: the "
+                "checkpoint already carries the full ensemble and "
+                "training state")
+        if resume == "auto":
+            ckpt = find_resume_checkpoint(cfg.output_model, fingerprint)
+        else:
+            ckpt = resume  # explicit path: read below (raises if corrupt)
+        if ckpt is not None:
+            state, arrays, texts = read_checkpoint(ckpt)
+            _restore(state, arrays, texts)
+            log.info(f"resume: restored {ckpt} at iteration "
+                     f"{booster.current_iteration()}")
+    elif nan_guard == "rollback":
+        log.warning("nan_guard=rollback needs resume checkpoints to "
+                    "roll back to (resume=off); divergence will raise "
+                    "instead")
+
+    import os as _os
+    chaos_kill_iter = _os.environ.get("LIGHTGBM_TPU_CHAOS_KILL_ITER")
+    chaos_kill_iter = (int(chaos_kill_iter)
+                       if chaos_kill_iter is not None else None)
+
+    def _chaos_kill(iteration: int) -> None:
+        # fault-injection hook (scripts/chaos_train.py): die right
+        # after the iteration's work — including any snapshot/
+        # checkpoint persistence — finishes
+        if chaos_kill_iter is None or iteration + 1 != chaos_kill_iter:
+            return
+        import signal as _signal
+        sig = (_signal.SIGTERM
+               if _os.environ.get("LIGHTGBM_TPU_CHAOS_KILL_SIGNAL",
+                                  "KILL") == "TERM"
+               else _signal.SIGKILL)
+        _os.kill(_os.getpid(), sig)
+
+    rollback_budget = 2
+
+    guard = PreemptionGuard(enabled=resume_on)
+    with guard:
+        i = booster.current_iteration()
+        while i < end_iteration:
+            if guard.fired:
+                # SIGTERM/SIGINT: drain the pending device ring (the
+                # checkpoint capture syncs), persist, exit cleanly
+                path = _write_ckpt(booster.current_iteration())
+                if guard.deadline_exceeded():
+                    log.warning("preemption drain exceeded the "
+                                f"{guard.deadline_s:g}s deadline")
+                raise TrainingPreempted(guard.signum,
+                                        booster.current_iteration(),
+                                        path)
+            env_before = CallbackEnv(booster, params, i, cadence_base,
+                                     end_iteration, None)
+            for cb in callbacks_before:
+                cb(env_before)
+            snapshot_here = (cfg.snapshot_freq > 0
+                             and (i + 1) % cfg.snapshot_freq == 0)
+            # sync points: every eval_period-th iteration, the final
+            # one, and snapshot boundaries. Between them the fused
+            # trainer defers — trees stay on device, no host syncs.
+            sync_here = ((i - cadence_base + 1) % eval_period == 0
+                         or i == end_iteration - 1 or snapshot_here)
+            try:
+                # step marker for jax.profiler traces (profiler.trace)
+                # — the per-iteration timing hook of gbdt.cpp:246-249
+                with profiler.step_annotation("boost_iter", step_num=i):
+                    stop = booster.update(fobj=fobj, defer=not sync_here)
+            except NumericDivergenceError as e:
+                if nan_guard != "rollback" or not resume_on:
+                    raise
+                ckpt = find_resume_checkpoint(cfg.output_model,
+                                              fingerprint)
+                if ckpt is None or rollback_budget <= 0:
+                    log.warning(
+                        "nan_guard: no checkpoint to roll back to"
+                        if ckpt is None else
+                        "nan_guard: rollback budget exhausted "
+                        "(deterministic divergence)")
+                    raise
+                rollback_budget -= 1
+                state, arrays, texts = read_checkpoint(ckpt)
+                _restore(state, arrays, texts)
+                log.warning(
+                    f"nan_guard incident: {e}; rolled back to {ckpt} "
+                    f"(iteration {booster.current_iteration()}) and "
+                    "re-running")
+                i = booster.current_iteration()
+                continue
+            if not (sync_here or stop):
+                _chaos_kill(i)
+                i += 1
+                continue
+            evals = []
+            need_eval = bool(eval_consumers) or cfg.early_stopping_round > 0
+            if need_eval:
+                with profiler.phase("eval"):
+                    if cfg.is_provide_training_metric and (
+                            train_metric_consumers or not callbacks_after):
+                        evals.extend(booster.eval_train(feval))
+                    evals.extend(booster.eval_valid(feval))
+            env = CallbackEnv(booster, params, i, cadence_base,
+                              end_iteration, evals)
+            try:
+                for cb in callbacks_after:
+                    cb(env)
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for name, metric, value, _ in (e.best_score or []):
+                    booster.best_score.setdefault(name, {})[metric] = value
+                break
+            if snapshot_here:
+                # periodic checkpoint (gbdt.cpp:250-254): full model
+                # text, resumable via init_model (atomic since the
+                # resilience PR), with snapshot_keep retention
+                booster.save_model(
+                    f"{cfg.output_model}.snapshot_iter_{i + 1}")
+                prune_numbered(cfg.output_model + ".snapshot_iter_",
+                               cfg.snapshot_keep)
+                if resume_on:
+                    _write_ckpt(i + 1)
+            _chaos_kill(i)
+            if stop:
+                break
+            i += 1
     return booster
 
 

@@ -1,0 +1,50 @@
+"""Fault-tolerance subsystem: preemption-safe checkpoint/resume with
+bit-identical recovery, numeric-divergence guards, and the hooks the
+fault-injection harness (``scripts/chaos_train.py``) drives.
+
+Three concerns, one package:
+
+- :mod:`.checkpoint` — full-state training checkpoints. The reference
+  persists only the model text at ``snapshot_freq`` boundaries
+  (gbdt.cpp:250-254); resuming via ``init_model`` restarts the host RNG
+  streams and re-derives scores from predictions, so a preempted run
+  converges to a *different* model than the uninterrupted one. The
+  checkpoint container serializes model text PLUS the complete mutable
+  training state (host RNG streams, device score accumulators, cached
+  bagging mask, early-stopping/eval history, iteration counter, config
+  fingerprint) behind a checksum footer, written atomically — so
+  ``engine.train(resume=auto)`` continues bit-identically across
+  fused/legacy drivers, serial/mesh learners and both dp_hist_merge
+  modes.
+- :mod:`.preemption` — SIGTERM/SIGINT double-signal guard. First signal
+  requests a graceful stop (engine.train drains the fused trainer's
+  pending device ring, writes a final checkpoint, raises
+  :class:`TrainingPreempted` within the deadline); a second signal
+  escalates to an immediate ``KeyboardInterrupt``.
+- :mod:`.guards` — :class:`NumericDivergenceError`, raised when the
+  sync-free NaN/Inf flag the fused step carries next to its no-split
+  stop flag reports non-finite gradients/scores (``nan_guard`` policy:
+  ``raise`` surfaces it, ``rollback`` restores the newest valid
+  checkpoint and re-runs).
+"""
+
+from .atomic_io import atomic_write_bytes, atomic_write_text  # noqa: F401
+from .guards import NumericDivergenceError  # noqa: F401
+from .preemption import PreemptionGuard, TrainingPreempted  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointError, checkpoint_path, config_fingerprint,
+    find_resume_checkpoint, is_valid_checkpoint, list_numbered,
+    prune_numbered, read_checkpoint, write_checkpoint,
+    capture_training_checkpoint, restore_training_checkpoint,
+    write_training_checkpoint)
+
+__all__ = [
+    "atomic_write_bytes", "atomic_write_text",
+    "NumericDivergenceError",
+    "PreemptionGuard", "TrainingPreempted",
+    "CheckpointError", "checkpoint_path", "config_fingerprint",
+    "find_resume_checkpoint", "is_valid_checkpoint", "list_numbered",
+    "prune_numbered", "read_checkpoint", "write_checkpoint",
+    "capture_training_checkpoint", "restore_training_checkpoint",
+    "write_training_checkpoint",
+]

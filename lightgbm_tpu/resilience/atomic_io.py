@@ -1,0 +1,50 @@
+"""Atomic file writes: tmp file in the target directory + fsync +
+``os.replace``.
+
+A plain ``open(path, "w").write(...)`` interrupted by SIGKILL (the
+preemptible-TPU common case) leaves a truncated file under the final
+name, which ``init_model``/resume then half-parses. The replace dance
+guarantees readers only ever observe the OLD complete file or the NEW
+complete file — never a prefix. The directory fsync makes the rename
+itself durable (without it a host crash can roll the directory entry
+back even though the data blocks landed).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+__all__ = ["atomic_write_bytes", "atomic_write_text"]
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Write ``data`` to ``path`` atomically (tmp + fsync + replace)."""
+    path = os.fspath(path)
+    dirname = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=os.path.basename(path) + ".tmp.",
+                               dir=dirname)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        tmp = None
+        try:
+            dfd = os.open(dirname, os.O_RDONLY)
+        except OSError:
+            return  # platform without directory fds; rename still atomic
+        try:
+            os.fsync(dfd)
+        except OSError:
+            pass  # some filesystems reject directory fsync; best effort
+        finally:
+            os.close(dfd)
+    finally:
+        if tmp is not None and os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"))

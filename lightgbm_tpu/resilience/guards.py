@@ -1,0 +1,32 @@
+"""Numeric-divergence guard error type.
+
+The fused boosting step computes a per-iteration finiteness flag over
+gradients/hessians/updated scores INSIDE the traced program and returns
+it on device next to the no-split ``should_continue`` flag — zero host
+syncs between eval points. ``GBDT.sync()`` reads both flags in its one
+batched ``device_get`` and raises this error for the first non-finite
+iteration when ``nan_guard`` is armed. The legacy per-phase driver
+checks eagerly (it already syncs every iteration).
+
+Policy (``nan_guard`` config param):
+
+- ``off``       — flag computed but ignored (bit-identical default)
+- ``raise``     — surface the error to the caller
+- ``rollback``  — engine.train restores the newest valid checkpoint,
+  logs the incident, and re-runs; a second divergence at the same
+  iteration (deterministic fault) re-raises
+"""
+
+from __future__ import annotations
+
+__all__ = ["NumericDivergenceError"]
+
+
+class NumericDivergenceError(RuntimeError):
+    """Non-finite gradients/scores detected at ``iteration``."""
+
+    def __init__(self, iteration: int, detail: str = ""):
+        msg = (f"non-finite gradients/scores at iteration "
+               f"{iteration}" + (f": {detail}" if detail else ""))
+        super().__init__(msg)
+        self.iteration = int(iteration)

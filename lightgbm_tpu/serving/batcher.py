@@ -78,7 +78,8 @@ def bucket_rows(n: int, min_bucket: int, max_batch_rows: int) -> int:
 
 
 class _Pending:
-    __slots__ = ("X", "done", "result", "error", "tag", "t_enqueue")
+    __slots__ = ("X", "done", "result", "error", "tag", "t_enqueue",
+                 "abandoned")
 
     def __init__(self, X: np.ndarray):
         self.X = X
@@ -87,6 +88,7 @@ class _Pending:
         self.error: Optional[BaseException] = None
         self.tag = None
         self.t_enqueue = time.monotonic()
+        self.abandoned = False
 
 
 class MicroBatcher:
@@ -161,7 +163,15 @@ class MicroBatcher:
             self._cond.notify_all()
         self.metrics.on_request(self.model, len(X))
         if not p.done.wait(timeout):
-            # the batch will still complete; this caller stops waiting
+            # unregister the abandoned promise: if it is still queued,
+            # remove it (its rows must stop counting against admission
+            # control); if a worker already took the batch, mark it so
+            # _run_batch won't fill a slot nobody reads
+            with self._cond:
+                p.abandoned = True
+                if p in self._queue:
+                    self._queue.remove(p)
+                    self._queued_rows -= len(p.X)
             raise TimeoutError("prediction did not complete in time")
         if p.error is not None:
             raise p.error
@@ -246,7 +256,8 @@ class MicroBatcher:
         self.metrics.on_batch(rows, t0 - batch[0].t_enqueue, compute_s)
         off = 0
         for p in batch:
-            p.result = out[off:off + len(p.X)]
-            p.tag = tag
+            if not p.abandoned:   # timed-out caller left; don't fill
+                p.result = out[off:off + len(p.X)]
+                p.tag = tag
             off += len(p.X)
             p.done.set()

@@ -25,6 +25,10 @@ over it — the gate must exit NON-zero, proving the rule still fires:
                        the ``build`` phase, the K-unrolled multiclass
                        iteration shape (TD005, the class_batch knob's
                        regression class)
+- ``nan-guard-sync`` — a boosting step that checks its NaN flag eagerly
+                       instead of returning it as a deferred device
+                       output (TD006, the resilience PR's
+                       host-sync-per-iteration regression class)
 
 Run: python scripts/lint_traces.py [--fast] [--seed CLASS]
 (CPU-only, no hardware needed; ``--fast`` lints one config cell and
@@ -47,7 +51,7 @@ def _load_probe():
 
 
 SEED_CLASSES = ("closure-const", "cpu-donation", "phase-collective",
-                "recompile-blowout", "class-unroll")
+                "recompile-blowout", "class-unroll", "nan-guard-sync")
 
 
 def _seed_closure_const() -> list:
@@ -129,6 +133,28 @@ def _seed_class_unroll() -> list:
                        max_build_programs=1)]
 
 
+def _seed_nan_guard_sync() -> list:
+    """Plant the eager-guard regression TD006 exists for: a boosting
+    step that device_get()s its finite flag inside the step (host sync
+    per iteration) and therefore returns only data — NO scalar-bool
+    flags reach the program interface."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.analysis import lint_deferred_guard
+
+    def step(scores, g):
+        new_scores = scores - 0.1 * g
+        # the anti-pattern: the finite check never becomes an output
+        # (a real implementation would bool() it right here, forcing
+        # the sync); the traced program exposes zero deferred flags
+        _ = jnp.all(jnp.isfinite(new_scores))
+        return new_scores
+    closed = jax.make_jaxpr(step)(jnp.ones((2, 64), jnp.float32),
+                                  jnp.ones((2, 64), jnp.float32))
+    return [lint_deferred_guard(closed, label="seed/nan_guard_sync",
+                                expect_flags=2)]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--seed", choices=SEED_CLASSES,
@@ -154,6 +180,7 @@ def main(argv=None) -> int:
             "phase-collective": _seed_phase_collective,
             "recompile-blowout": _seed_recompile_blowout,
             "class-unroll": _seed_class_unroll,
+            "nan-guard-sync": _seed_nan_guard_sync,
         }[ns.seed]()
         for r in reports:
             print(r.render(verbose=True))

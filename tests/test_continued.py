@@ -190,3 +190,42 @@ def test_continued_rf_uses_boost_from_average(rng):
     internal = cont._gbdt.eval_scores(-1)[:, 0]
     avg = np.mean([t.predict(X) for t in cont._all_trees()], axis=0)
     np.testing.assert_allclose(internal, avg, rtol=2e-4, atol=2e-4)
+
+
+def test_snapshot_resume_via_init_model(rng, tmp_path):
+    """Periodic snapshots (snapshot_freq) are plain model files: any of
+    them continues training via init_model. This is the LEGACY resume
+    path — scores are rebuilt by re-predicting the raw data and the
+    bagging RNG streams restart — so the continuation is a valid model
+    but NOT a bit-identical replay of the uninterrupted run (the
+    resilience checkpoints, resume=auto, give bit-identical recovery)."""
+    import os
+
+    X, y = _data(rng, n=1500)
+    model = str(tmp_path / "m.txt")
+    params = dict(PARAMS, bagging_fraction=0.8, bagging_freq=1,
+                  bagging_seed=7, snapshot_freq=3, output_model=model)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    full = lgb.train(params, ds, 9)
+    snaps = sorted(f for f in os.listdir(tmp_path)
+                   if ".snapshot_iter_" in f)
+    assert [int(s.rsplit("_", 1)[1]) for s in snaps] == [3, 6, 9]
+
+    snap6 = model + ".snapshot_iter_6"
+    mid = lgb.Booster(model_file=snap6)
+    assert mid.num_trees() == 6
+    ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+    cont = lgb.train(params, ds2, 3, init_model=snap6)
+    assert cont.num_trees() == 9
+    assert cont.current_iteration() == 9
+    # the restored prefix round-trips bit-exactly: the snapshot's tree
+    # section reappears verbatim inside the continued model's text
+    mid_trees = mid.model_to_string().split("Tree=0", 1)[1] \
+                                     .split("end of trees")[0]
+    assert "Tree=0" + mid_trees in cont.model_to_string()
+    # ...but the continuation itself is NOT the uninterrupted run: the
+    # restarted bagging stream draws different masks for trees 7-9
+    assert cont.model_to_string() != full.model_to_string()
+    # it is still a sound model on the task
+    from sklearn.metrics import roc_auc_score
+    assert roc_auc_score(y, cont.predict(X)) > 0.8

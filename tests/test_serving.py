@@ -143,6 +143,39 @@ def test_overload_fast_fail():
         np.testing.assert_array_equal(out, np.ones(4))
 
 
+def test_timeout_unregisters_abandoned_request():
+    """A timed-out submit must unregister its promise: rows of a
+    still-queued request stop counting against admission control, an
+    in-flight request's result slot is never filled for a caller that
+    left, and the batcher keeps serving afterwards."""
+    release = threading.Event()
+
+    def slow(X):
+        release.wait(10)
+        return X[:, 0]
+
+    b = MicroBatcher(slow, max_batch_rows=4, max_wait_us=0,
+                     max_queue_rows=8)
+    # in-flight abandonment: the worker takes this batch and blocks in
+    # the model; the caller gives up waiting
+    with pytest.raises(TimeoutError):
+        b.submit(np.ones((4, 2)), timeout=0.2)
+    # queued abandonment: the worker is still blocked, so this request
+    # never leaves the queue before its deadline
+    with pytest.raises(TimeoutError):
+        b.submit(np.ones((4, 2)), timeout=0.2)
+    with b._cond:
+        assert b._queue == []
+        assert b._queued_rows == 0, \
+            "abandoned rows still count against admission control"
+    release.set()
+    # the freed capacity is usable again — this would Overload (8-row
+    # cap) if the two abandoned 4-row requests still counted
+    out = b.submit(np.ones((8, 2)), timeout=30)
+    np.testing.assert_array_equal(out, np.ones(8))
+    b.close()
+
+
 def test_batch_error_propagates_to_every_request():
     def boom(X):
         raise ValueError("model exploded")
