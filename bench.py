@@ -43,6 +43,14 @@ class_batch on vs off, the fused-step jaxpr equation count and the
 number of build-phase grow loops staged per program (ONE when batched,
 K when unrolled), and the K=10 compile-time reduction ratio.
 BENCH_MULTICLASS=0 skips; BENCH_MC_ROWS / BENCH_MC_ITERS size it.
+ISSUE 10 adds the observability fields: per-phase per-iteration seconds
+(`phase_s_per_iter_*`, from profiler.collect_phase_totals around the
+headline timed loop — the same numbers a live run's telemetry iteration
+records carry) and the `telemetry_bench` probe
+(`telemetry_overhead_pct`: ms/tree with the full telemetry stack armed
+vs off at eval_period=16, plus `telemetry_added_syncs_per_iter`, which
+must stay 0 — the subsystem observes only at existing sync points).
+BENCH_TELEMETRY=0 skips.
 """
 
 import json
@@ -829,6 +837,74 @@ def resilience_bench() -> dict:
     return out
 
 
+def telemetry_bench() -> dict:
+    """Telemetry overhead probe (ISSUE 10): the fused steady-state run
+    (64k rows, eval_period=16) with the full observation stack armed —
+    event log, metrics registry, device watch, live introspection
+    server — vs the same run with telemetry off.
+    `telemetry_overhead_pct` is the ms/tree cost of being watched, and
+    `telemetry_added_syncs_per_iter` must stay 0: a callback snapshots
+    `host_sync_count` at every eval-cadence sync point in BOTH runs, so
+    any telemetry-induced host sync between eval points would surface
+    as a per-window delta. BENCH_TELEMETRY=0 skips."""
+    import tempfile
+    import lightgbm_tpu as lgb
+    rows = int(os.environ.get("BENCH_TELEMETRY_ROWS", 1 << 16))
+    iters = int(os.environ.get("BENCH_TELEMETRY_ITERS", 48))
+    ep = 16
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(rows, 16)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float32)
+    base = dict(objective="binary", num_leaves=31, learning_rate=0.1,
+                min_data_in_leaf=20, verbosity=-1, fused_train=True,
+                eval_period=ep)
+    out = {"telemetry_rows": rows, "telemetry_iters": iters,
+           "telemetry_eval_period": ep}
+    ds = lgb.Dataset(X, label=y, free_raw_data=False).construct()
+
+    with tempfile.TemporaryDirectory(prefix="bench_tele_") as td:
+        run_id = [0]
+
+        def run(tele: bool):
+            params = dict(base)
+            if tele:
+                run_id[0] += 1
+                params.update(telemetry_port=0, event_log=os.path.join(
+                    td, f"r{run_id[0]}.events.jsonl"))
+            syncs = []
+
+            def watch(env):
+                syncs.append(env.model._gbdt.host_sync_count)
+            t0 = time.time()
+            bst = lgb.train(params, ds, num_boost_round=iters,
+                            callbacks=[watch])
+            bst._gbdt.scores.block_until_ready()
+            return time.time() - t0, syncs
+
+        run(True)                   # compile + warm both variants
+        run(False)
+        # best-of-3 per variant: the overhead is a small delta, and
+        # single-shot wall clocks on a shared host fold scheduler noise
+        # straight into the percentage
+        dt_off = min(run(False)[0] for _ in range(3))
+        best_on, syncs_on = None, None
+        for _ in range(3):
+            dt, syncs = run(True)
+            if best_on is None or dt < best_on:
+                best_on, syncs_on = dt, syncs
+        _, syncs_off = run(False)
+        out["ms_per_tree_telemetry_off"] = round(dt_off / iters * 1e3, 3)
+        out["ms_per_tree_telemetry_on"] = round(best_on / iters * 1e3, 3)
+        out["telemetry_overhead_pct"] = round(
+            (best_on - dt_off) / dt_off * 100.0, 2)
+        win_on = np.diff(syncs_on) if len(syncs_on) > 1 else []
+        win_off = np.diff(syncs_off) if len(syncs_off) > 1 else []
+        out["telemetry_added_syncs_per_iter"] = round(
+            float(np.sum(win_on) - np.sum(win_off))
+            / max(1, len(win_on) * ep), 4)
+    return out
+
+
 def compile_cache_probe() -> dict:
     """Cold vs warm compile+warmup seconds through the persistent XLA
     compilation cache (engine.enable_compilation_cache): the identical
@@ -984,12 +1060,19 @@ def main():
     print(f"binning {t_bin:.1f}s; compile+{warmup} warmup iters "
           f"{t_compile:.1f}s", file=sys.stderr)
 
+    from lightgbm_tpu import profiler
     t1 = time.time()
-    for _ in range(iters):
-        bst.update()
-    # force all queued device work to finish
-    bst._gbdt.scores.block_until_ready()
+    with profiler.collect_phase_totals() as phases:
+        for _ in range(iters):
+            bst.update()
+        # force all queued device work to finish
+        bst._gbdt.scores.block_until_ready()
     dt = time.time() - t1
+    # per-phase per-iteration seconds on the headline line (ISSUE 10):
+    # the same numbers a live run's telemetry iteration records carry
+    phase_fields = {
+        f"phase_s_per_iter_{name}": round(d["s_per_iter"], 6)
+        for name, d in phases.per_iteration(iters).items()}
 
     throughput = n_rows * iters / dt
     auc = bst.eval_train()[0][2]
@@ -1158,6 +1241,14 @@ def main():
         except Exception as e:  # noqa: BLE001 — probes never kill bench
             print(f"resilience bench failed: {e}", file=sys.stderr)
 
+    tele_fields = {}
+    if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+        try:
+            tele_fields = telemetry_bench()
+            print(f"telemetry overhead: {tele_fields}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"telemetry bench failed: {e}", file=sys.stderr)
+
     cc_fields = {}
     if os.environ.get("BENCH_COMPILE_CACHE", "1") != "0":
         try:
@@ -1191,6 +1282,7 @@ def main():
         "compile_warmup_s": round(t_compile, 2),
         "train_s": round(dt, 2),
         "ms_per_tree": round(dt / iters * 1e3, 1),
+        **phase_fields,
         **stream_fields,
         **quant_fields,
         **pred_fields,
@@ -1199,6 +1291,7 @@ def main():
         **dp_fields,
         **mc_fields,
         **res_fields,
+        **tele_fields,
         **cc_fields,
         **serve_fields,
         **ref_fields,

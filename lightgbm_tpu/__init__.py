@@ -18,6 +18,7 @@ from .engine import (Booster, CVBooster, PredictSession, cv,
                      enable_compilation_cache, train)
 from .log import register_logger
 from . import serving
+from . import telemetry
 from .serving import (MicroBatcher, ModelRegistry, PredictionServer,
                       ServingMetrics)
 from .tree import Tree
@@ -36,7 +37,7 @@ __version__ = "0.1.0"
 
 __all__ = ["Dataset", "Booster", "CVBooster", "PredictSession", "train",
            "cv", "Config", "enable_compilation_cache",
-           "serving", "MicroBatcher", "ModelRegistry",
+           "serving", "telemetry", "MicroBatcher", "ModelRegistry",
            "PredictionServer", "ServingMetrics",
            "BinMapper", "Tree", "Sequence", "early_stopping", "log_evaluation",
            "record_evaluation", "reset_parameter", "EarlyStopException",

@@ -65,6 +65,13 @@ CANONICAL_CONFIGS: Dict[str, Tuple[dict, dict]] = {
     # an eager per-iteration host check
     "nan_guard": ({"nan_guard": "rollback", "bagging_fraction": 0.8,
                    "bagging_freq": 2, "bagging_seed": 7}, {}),
+    # full telemetry stack armed (event log + live endpoints + armed
+    # guard): the sync-free contract must survive observation — no host
+    # callbacks enter the staged program (TD002) and the deferred guard
+    # flag stays a program output (TD006). event_log="auto" is rerouted
+    # to a scratch dir by make_booster.
+    "telemetry": ({"nan_guard": "rollback", "event_log": "auto",
+                   "telemetry_port": 0}, {}),
 }
 PARALLEL_MODES = ("serial", "data")
 
@@ -114,6 +121,13 @@ def make_booster(config: str = "plain", mode: str = "serial", *,
     # explicit even for serial: on a multi-device host the trainer
     # otherwise auto-selects a parallel plan
     params = dict(_BASE_PARAMS, **overrides, tree_learner=mode)
+    if params.get("event_log"):
+        # telemetry cell: keep the event log (and auto's output_model
+        # anchor) out of the caller's cwd
+        import tempfile
+        scratch = tempfile.mkdtemp(prefix="lgbtpu_doctor_")
+        params["event_log"] = os.path.join(scratch,
+                                           "doctor.events.jsonl")
     with _pin_fused(fused):
         ds = lgb.Dataset(X, label=y, **ds_kw)
         return lgb.train(params, ds, num_boost_round=rounds)

@@ -228,8 +228,10 @@ def main(argv: Optional[List[str]] = None) -> int:
               "       python -m lightgbm_tpu trace-doctor [--config ...]"
               " [--mode ...]\n"
               "       python -m lightgbm_tpu chaos [--fast] [--cell ...]\n"
+              "       python -m lightgbm_tpu monitor <run_dir|events."
+              "jsonl> [--check]\n"
               "tasks: train | predict | refit | save_binary | serve | "
-              "trace-doctor | chaos")
+              "trace-doctor | chaos | monitor")
         return 0
     # `python -m lightgbm_tpu serve model=...` — subcommand spelling of
     # task=serve (the reference CLI is key=value only; serve is ours)
@@ -243,6 +245,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     # `chaos` — the fault-injection harness (scripts/chaos_train.py):
     # kills training at arbitrary iterations, corrupts checkpoints,
     # poisons gradients, and asserts bit-identical recovery
+    # `monitor` — render a run-event log (telemetry/events.py) into a
+    # phase/throughput/faults report; `--check` is the schema self-check
+    if argv[0] == "monitor":
+        from .telemetry.monitor import monitor_main
+        return monitor_main(argv[1:])
     if argv[0] == "chaos":
         import importlib.util
         here = os.path.dirname(os.path.abspath(__file__))

@@ -357,6 +357,25 @@ PARAMS: Dict[str, ParamSpec] = {
                "SIGTERM/SIGINT preemption handler: the first signal "
                "drains pending device work, writes a final checkpoint, "
                "and exits cleanly"),
+        # -- runtime telemetry (telemetry subsystem, no reference analog)
+        _p("telemetry_port", -1, int,
+           doc="opt-in live introspection server during training "
+               "(telemetry/exporter.py): >= 0 binds 127.0.0.1:<port> "
+               "(0 picks a free port) serving /metrics (Prometheus), "
+               "/events tail, /healthz and /trace?duration_ms= "
+               "(on-demand jax.profiler capture); -1 (default) "
+               "disables. The LIGHTGBM_TPU_TELEMETRY_PORT env var is "
+               "the no-code-change spelling and applies when the param "
+               "is unset. Scrapes read host-side state only — the "
+               "dispatch-ahead training loop gains zero host syncs"),
+        _p("event_log", "", str,
+           doc="structured run-event log (telemetry/events.py): a path "
+               "writes append-only JSONL records (run header, "
+               "eval-point iterations with per-phase seconds, "
+               "checkpoint write/restore, preemption, nan-guard, "
+               "warnings) emitted only at existing sync points; 'auto' "
+               "derives <output_model>.events.jsonl; empty (default) "
+               "disables. Render with `python -m lightgbm_tpu monitor`"),
         _p("nan_guard", "off", str,
            check=lambda v: v in ("off", "raise", "rollback"),
            doc="sync-free NaN/Inf detection on gradients/scores, "

@@ -1355,7 +1355,14 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     resume = str(cfg.resume)
     resume_on = resume != "off"
     nan_guard = str(cfg.nan_guard)
-    fingerprint = config_fingerprint(params) if resume_on else None
+    # -- runtime telemetry (telemetry subsystem) ---------------------
+    # None unless telemetry_port/event_log (or the env var) opt in; all
+    # session hooks below run at points that have already synced, so a
+    # telemetry-enabled run issues the same device syncs as a bare one.
+    from .telemetry import TelemetrySession
+    tele = TelemetrySession.from_config(cfg, params)
+    fingerprint = (config_fingerprint(params)
+                   if resume_on or tele is not None else None)
     # cadence_base anchors the eval/snapshot cadence. A resumed run
     # must reuse the ORIGINAL run's anchor — recomputing it from the
     # restored iteration would shift every sync point and early
@@ -1383,8 +1390,11 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
             end_iteration=end_iteration, params=params)
         prune_numbered(cfg.output_model + ".ckpt_iter_",
                        cfg.snapshot_keep)
+        if tele is not None:
+            tele.on_checkpoint("write", iteration, path)
         return path
 
+    resumed_from = None
     if resume_on:
         if init_model is not None:
             raise ValueError(
@@ -1398,12 +1408,20 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         if ckpt is not None:
             state, arrays, texts = read_checkpoint(ckpt)
             _restore(state, arrays, texts)
+            resumed_from = (str(ckpt), booster.current_iteration())
             log.info(f"resume: restored {ckpt} at iteration "
                      f"{booster.current_iteration()}")
     elif nan_guard == "rollback":
         log.warning("nan_guard=rollback needs resume checkpoints to "
                     "roll back to (resume=off); divergence will raise "
                     "instead")
+
+    if tele is not None:
+        # after any resume restore: begin_run splices the event log to
+        # the restored iteration, then re-emits the run header (same
+        # fingerprint) so the resumed record chain reads uninterrupted
+        tele.begin_run(booster, cfg, params, fingerprint,
+                       resumed_from=resumed_from)
 
     import os as _os
     chaos_kill_iter = _os.environ.get("LIGHTGBM_TPU_CHAOS_KILL_ITER")
@@ -1426,92 +1444,122 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     rollback_budget = 2
 
     guard = PreemptionGuard(enabled=resume_on)
-    with guard:
-        i = booster.current_iteration()
-        while i < end_iteration:
-            if guard.fired:
-                # SIGTERM/SIGINT: drain the pending device ring (the
-                # checkpoint capture syncs), persist, exit cleanly
-                path = _write_ckpt(booster.current_iteration())
-                if guard.deadline_exceeded():
-                    log.warning("preemption drain exceeded the "
-                                f"{guard.deadline_s:g}s deadline")
-                raise TrainingPreempted(guard.signum,
-                                        booster.current_iteration(),
-                                        path)
-            env_before = CallbackEnv(booster, params, i, cadence_base,
-                                     end_iteration, None)
-            for cb in callbacks_before:
-                cb(env_before)
-            snapshot_here = (cfg.snapshot_freq > 0
-                             and (i + 1) % cfg.snapshot_freq == 0)
-            # sync points: every eval_period-th iteration, the final
-            # one, and snapshot boundaries. Between them the fused
-            # trainer defers — trees stay on device, no host syncs.
-            sync_here = ((i - cadence_base + 1) % eval_period == 0
-                         or i == end_iteration - 1 or snapshot_here)
-            try:
-                # step marker for jax.profiler traces (profiler.trace)
-                # — the per-iteration timing hook of gbdt.cpp:246-249
-                with profiler.step_annotation("boost_iter", step_num=i):
-                    stop = booster.update(fobj=fobj, defer=not sync_here)
-            except NumericDivergenceError as e:
-                if nan_guard != "rollback" or not resume_on:
-                    raise
-                ckpt = find_resume_checkpoint(cfg.output_model,
-                                              fingerprint)
-                if ckpt is None or rollback_budget <= 0:
+    ok = False
+    try:
+        with guard:
+            i = booster.current_iteration()
+            while i < end_iteration:
+                if guard.fired:
+                    # SIGTERM/SIGINT: drain the pending device ring (the
+                    # checkpoint capture syncs), persist, exit cleanly
+                    path = _write_ckpt(booster.current_iteration())
+                    if guard.deadline_exceeded():
+                        log.warning("preemption drain exceeded the "
+                                    f"{guard.deadline_s:g}s deadline")
+                    if tele is not None:
+                        tele.on_preemption(guard.signum,
+                                           booster.current_iteration())
+                    raise TrainingPreempted(guard.signum,
+                                            booster.current_iteration(),
+                                            path)
+                env_before = CallbackEnv(booster, params, i, cadence_base,
+                                         end_iteration, None)
+                for cb in callbacks_before:
+                    cb(env_before)
+                snapshot_here = (cfg.snapshot_freq > 0
+                                 and (i + 1) % cfg.snapshot_freq == 0)
+                # sync points: every eval_period-th iteration, the final
+                # one, and snapshot boundaries. Between them the fused
+                # trainer defers — trees stay on device, no host syncs.
+                sync_here = ((i - cadence_base + 1) % eval_period == 0
+                             or i == end_iteration - 1 or snapshot_here)
+                try:
+                    # step marker for jax.profiler traces (profiler.trace)
+                    # — the per-iteration timing hook of gbdt.cpp:246-249
+                    with profiler.step_annotation("boost_iter", step_num=i):
+                        stop = booster.update(fobj=fobj, defer=not sync_here)
+                except NumericDivergenceError as e:
+                    if nan_guard != "rollback" or not resume_on:
+                        if tele is not None:
+                            tele.on_nan_guard(getattr(e, "iteration", i + 1),
+                                              nan_guard, "raise")
+                        raise
+                    ckpt = find_resume_checkpoint(cfg.output_model,
+                                                  fingerprint)
+                    if ckpt is None or rollback_budget <= 0:
+                        log.warning(
+                            "nan_guard: no checkpoint to roll back to"
+                            if ckpt is None else
+                            "nan_guard: rollback budget exhausted "
+                            "(deterministic divergence)")
+                        if tele is not None:
+                            tele.on_nan_guard(getattr(e, "iteration", i + 1),
+                                              nan_guard, "raise")
+                        raise
+                    rollback_budget -= 1
+                    state, arrays, texts = read_checkpoint(ckpt)
+                    _restore(state, arrays, texts)
                     log.warning(
-                        "nan_guard: no checkpoint to roll back to"
-                        if ckpt is None else
-                        "nan_guard: rollback budget exhausted "
-                        "(deterministic divergence)")
-                    raise
-                rollback_budget -= 1
-                state, arrays, texts = read_checkpoint(ckpt)
-                _restore(state, arrays, texts)
-                log.warning(
-                    f"nan_guard incident: {e}; rolled back to {ckpt} "
-                    f"(iteration {booster.current_iteration()}) and "
-                    "re-running")
-                i = booster.current_iteration()
-                continue
-            if not (sync_here or stop):
+                        f"nan_guard incident: {e}; rolled back to {ckpt} "
+                        f"(iteration {booster.current_iteration()}) and "
+                        "re-running")
+                    if tele is not None:
+                        tele.on_nan_guard(getattr(e, "iteration", i + 1),
+                                          nan_guard, "rollback")
+                        tele.on_checkpoint("restore",
+                                           booster.current_iteration(),
+                                           str(ckpt))
+                    i = booster.current_iteration()
+                    continue
+                if not (sync_here or stop):
+                    _chaos_kill(i)
+                    i += 1
+                    continue
+                evals = []
+                need_eval = bool(eval_consumers) or cfg.early_stopping_round > 0
+                if need_eval:
+                    with profiler.phase("eval"):
+                        if cfg.is_provide_training_metric and (
+                                train_metric_consumers or not callbacks_after):
+                            evals.extend(booster.eval_train(feval))
+                        evals.extend(booster.eval_valid(feval))
+                if tele is not None:
+                    # the eval-cadence sync point: booster.update just
+                    # drained the ring, evals are host floats — the
+                    # iteration record costs no extra device sync
+                    tele.on_sync(i + 1, evals)
+                env = CallbackEnv(booster, params, i, cadence_base,
+                                  end_iteration, evals)
+                try:
+                    for cb in callbacks_after:
+                        cb(env)
+                except EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    for name, metric, value, _ in (e.best_score or []):
+                        booster.best_score.setdefault(name, {})[metric] = value
+                    if tele is not None:
+                        tele.on_early_stop(i + 1, booster.best_iteration)
+                    break
+                if snapshot_here:
+                    # periodic checkpoint (gbdt.cpp:250-254): full model
+                    # text, resumable via init_model (atomic since the
+                    # resilience PR), with snapshot_keep retention
+                    booster.save_model(
+                        f"{cfg.output_model}.snapshot_iter_{i + 1}")
+                    prune_numbered(cfg.output_model + ".snapshot_iter_",
+                                   cfg.snapshot_keep)
+                    if resume_on:
+                        _write_ckpt(i + 1)
                 _chaos_kill(i)
+                if stop:
+                    break
                 i += 1
-                continue
-            evals = []
-            need_eval = bool(eval_consumers) or cfg.early_stopping_round > 0
-            if need_eval:
-                with profiler.phase("eval"):
-                    if cfg.is_provide_training_metric and (
-                            train_metric_consumers or not callbacks_after):
-                        evals.extend(booster.eval_train(feval))
-                    evals.extend(booster.eval_valid(feval))
-            env = CallbackEnv(booster, params, i, cadence_base,
-                              end_iteration, evals)
-            try:
-                for cb in callbacks_after:
-                    cb(env)
-            except EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                for name, metric, value, _ in (e.best_score or []):
-                    booster.best_score.setdefault(name, {})[metric] = value
-                break
-            if snapshot_here:
-                # periodic checkpoint (gbdt.cpp:250-254): full model
-                # text, resumable via init_model (atomic since the
-                # resilience PR), with snapshot_keep retention
-                booster.save_model(
-                    f"{cfg.output_model}.snapshot_iter_{i + 1}")
-                prune_numbered(cfg.output_model + ".snapshot_iter_",
-                               cfg.snapshot_keep)
-                if resume_on:
-                    _write_ckpt(i + 1)
-            _chaos_kill(i)
-            if stop:
-                break
-            i += 1
+        ok = True
+    finally:
+        if tele is not None:
+            # ended=False (fault unwinding) suppresses train_end
+            # so the fault record stays the log's last word
+            tele.close(ended=ok)
     return booster
 
 

@@ -60,6 +60,18 @@ def _emit(level: int, msg: str, warn: bool = False) -> None:
         print(msg, file=sys.stderr if warn else sys.stdout, flush=True)
 
 
+def _record(level: str, msg: str) -> None:
+    """Single choke point routing warnings/fatals into the active run's
+    event log (telemetry/events.py). Best-effort and lazy: telemetry
+    imports this module, so the import happens at call time, and a run
+    with no active EventLog makes this a no-op."""
+    try:
+        from .telemetry.events import record_log
+    except Exception:  # noqa: BLE001 — logging must never raise
+        return
+    record_log(level, msg)
+
+
 def eval_info(msg: str) -> None:
     """Evaluation lines from user-requested callbacks (log_evaluation,
     early_stopping): honor the logger redirection but bypass the
@@ -79,9 +91,11 @@ def info(msg: str) -> None:
 
 
 def warning(msg: str) -> None:
+    _record("warning", msg)
     _emit(_WARNING, f"[LightGBM-TPU] [Warning] {msg}", warn=True)
 
 
 def fatal(msg: str) -> None:
     """Log::Fatal throws (log.h:143); always raises regardless of level."""
+    _record("fatal", msg)
     raise RuntimeError(f"[LightGBM-TPU] [Fatal] {msg}")

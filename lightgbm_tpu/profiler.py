@@ -63,7 +63,8 @@ from typing import Dict, Iterator, List, Optional, Tuple
 from .phases import KNOWN_PHASES
 
 __all__ = ["trace", "step_annotation", "annotate", "phase",
-           "PhaseTotals", "collect_phase_totals"]
+           "PhaseTotals", "collect_phase_totals",
+           "add_phase_collector", "remove_phase_collector"]
 
 
 @contextlib.contextmanager
@@ -110,14 +111,16 @@ def phase(name: str) -> Iterator[None]:
             f"{sorted(KNOWN_PHASES)} (lightgbm_tpu/phases.py — add new "
             "phases there so the HLO auditors keep attributing them)")
     import jax
-    col = _ACTIVE_TOTALS
-    t0 = time.perf_counter() if col is not None else 0.0
+    cols = _COLLECTORS
+    t0 = time.perf_counter() if cols else 0.0
     try:
         with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
             yield
     finally:
-        if col is not None:
-            col._record(name, time.perf_counter() - t0)
+        if cols:
+            dt = time.perf_counter() - t0
+            for col in cols:
+                col._record(name, dt)
 
 
 # ----------------------------------------------------------------------
@@ -131,7 +134,22 @@ def phase(name: str) -> Iterator[None]:
 # per-phase TOTALS per run keeps before/after timings comparable — the
 # sum over K unrolled spans lines up against the one batched span.
 
-_ACTIVE_TOTALS: Optional["PhaseTotals"] = None
+# Every active collector sees every span (a tuple, swapped atomically
+# under the GIL): bench's collect_phase_totals() around lgb.train and
+# the telemetry session's collector inside it both need the spans —
+# a single-slot design would make the inner one steal from the outer.
+_COLLECTORS: Tuple["PhaseTotals", ...] = ()
+
+
+def add_phase_collector(col: "PhaseTotals") -> None:
+    """Register an additional live collector (telemetry session)."""
+    global _COLLECTORS
+    _COLLECTORS = _COLLECTORS + (col,)
+
+
+def remove_phase_collector(col: "PhaseTotals") -> None:
+    global _COLLECTORS
+    _COLLECTORS = tuple(c for c in _COLLECTORS if c is not col)
 
 
 class PhaseTotals:
@@ -184,15 +202,14 @@ class PhaseTotals:
 @contextlib.contextmanager
 def collect_phase_totals() -> Iterator[PhaseTotals]:
     """Aggregate every :func:`phase` span inside the block into a
-    :class:`PhaseTotals` (opt-in; nesting restores the outer
-    collector). Host-side wall clock: around eager dispatches (legacy
-    driver) the span covers dispatch + device wait; around staged code
-    (inside a trace) it covers trace time only."""
-    global _ACTIVE_TOTALS
-    prev = _ACTIVE_TOTALS
+    :class:`PhaseTotals` (opt-in; collectors STACK — a nested block or
+    a live telemetry session each get the same spans). Host-side wall
+    clock: around eager dispatches (legacy driver) the span covers
+    dispatch + device wait; around staged code (inside a trace) it
+    covers trace time only."""
     col = PhaseTotals()
-    _ACTIVE_TOTALS = col
+    add_phase_collector(col)
     try:
         yield col
     finally:
-        _ACTIVE_TOTALS = prev
+        remove_phase_collector(col)

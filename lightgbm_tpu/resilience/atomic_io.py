@@ -15,7 +15,8 @@ from __future__ import annotations
 import os
 import tempfile
 
-__all__ = ["atomic_write_bytes", "atomic_write_text"]
+__all__ = ["atomic_write_bytes", "atomic_write_text",
+           "atomic_append_line"]
 
 
 def atomic_write_bytes(path: str, data: bytes) -> None:
@@ -48,3 +49,32 @@ def atomic_write_bytes(path: str, data: bytes) -> None:
 
 def atomic_write_text(path: str, text: str) -> None:
     atomic_write_bytes(path, text.encode("utf-8"))
+
+
+def atomic_append_line(path: str, line: str, fsync: bool = False) -> None:
+    """Append one newline-terminated record to ``path`` atomically
+    with respect to line boundaries (the telemetry event log's JSONL
+    appends).
+
+    ``O_APPEND`` + a single ``os.write`` of the whole record means a
+    reader (or a concurrent appender) never observes a torn line: POSIX
+    serializes the offset bump with the write. A SIGKILL mid-write can
+    still truncate the FINAL record — readers of the event log treat a
+    non-parsing last line as an interrupted run's tail, the same
+    old-or-new contract :func:`atomic_write_bytes` gives whole files.
+    ``fsync`` is opt-in: the event log is an observability artifact,
+    not recovery state (checkpoints are), so losing the page-cache tail
+    on host crash is acceptable by default and keeps appends off the
+    disk-latency path.
+    """
+    data = line.encode("utf-8")
+    if not data.endswith(b"\n"):
+        data += b"\n"
+    fd = os.open(os.fspath(path),
+                 os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        os.write(fd, data)
+        if fsync:
+            os.fsync(fd)
+    finally:
+        os.close(fd)

@@ -8,85 +8,25 @@ vs compute split (is latency admission or the kernel?), batch-size
 distribution (is coalescing happening?), and per-model request/error
 counts (is a deploy failing?).
 
-Design notes:
-
-- Counters take one uncontended ``threading.Lock`` per increment
-  (~100 ns) — CPython attribute ``+=`` is NOT atomic (LOAD/ADD/STORE
-  can interleave at the bytecode boundary), so the lock is the cheapest
-  *correct* primitive; reads are single attribute loads and need none.
-- Histograms write into a fixed-size ring (an index bump + one slot
-  store under the same cheap lock). Percentiles are computed only at
-  scrape time, over the last ``size`` observations, so the hot path
-  never sorts and memory never grows with traffic.
+The primitives (Counter, RingHistogram) and the text renderer live in
+``telemetry/core.py`` now — they started here and were generalized so
+training shares them; this module keeps the serving-specific field set
+and its exact render bytes (pinned by tests). A ``PredictionServer``
+mounts this set onto its :class:`~lightgbm_tpu.telemetry.core.
+MetricsRegistry` as a collector, so ``/metrics`` is one registry render
+on both the training and serving sides.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, List
 
-import numpy as np
+from ..telemetry.core import (Counter, RingHistogram, render_counter,
+                              render_summary)
 
 __all__ = ["Counter", "RingHistogram", "ServingMetrics"]
-
-
-class Counter:
-    """Monotonic counter with optional labelled children."""
-
-    __slots__ = ("_lock", "_value")
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._value = 0
-
-    def inc(self, n: int = 1):
-        with self._lock:
-            self._value += n
-
-    @property
-    def value(self) -> int:
-        return self._value  # single attribute load: atomic under the GIL
-
-
-class RingHistogram:
-    """Fixed-size ring of float observations (latencies, batch sizes).
-
-    ``observe`` is O(1); quantiles/mean are computed at scrape time over
-    the retained window (the last ``size`` observations), which is the
-    operationally useful view — a serving dashboard wants *recent* p99,
-    not the all-time one that a cumulative histogram would smear.
-    """
-
-    __slots__ = ("_lock", "_buf", "_n")
-
-    def __init__(self, size: int = 4096):
-        self._lock = threading.Lock()
-        self._buf = np.zeros(int(size), np.float64)
-        self._n = 0
-
-    def observe(self, value: float):
-        with self._lock:
-            self._buf[self._n % len(self._buf)] = value
-            self._n += 1
-
-    @property
-    def count(self) -> int:
-        return self._n
-
-    def window(self) -> np.ndarray:
-        """Copy of the retained observations (unordered)."""
-        with self._lock:
-            return self._buf[: min(self._n, len(self._buf))].copy()
-
-    def summary(self, qs: Iterable[float] = (0.5, 0.95, 0.99)
-                ) -> Tuple[Dict[float, float], int, float]:
-        """({quantile: value}, total_count, window_mean)."""
-        w = self.window()
-        if w.size == 0:
-            return {q: 0.0 for q in qs}, self._n, 0.0
-        return ({q: float(np.percentile(w, 100.0 * q)) for q in qs},
-                self._n, float(w.mean()))
 
 
 class ServingMetrics:
@@ -173,46 +113,33 @@ class ServingMetrics:
         """Prometheus text exposition (text/plain; version=0.0.4)."""
         out: List[str] = []
 
-        def counter(name, help_, pairs):
-            out.append(f"# HELP {name} {help_}")
-            out.append(f"# TYPE {name} counter")
-            for labels, v in pairs:
-                out.append(f"{name}{labels} {v}")
-
-        def summary(name, help_, hist, scale=1.0):
-            qs, cnt, mean = hist.summary()
-            out.append(f"# HELP {name} {help_}")
-            out.append(f"# TYPE {name} summary")
-            for q, v in qs.items():
-                out.append(f'{name}{{quantile="{q:g}"}} {v * scale:.9g}')
-            out.append(f"{name}_count {cnt}")
-            out.append(f"{name}_mean {mean * scale:.9g}")
-
-        counter("serve_requests_total", "Accepted predict requests",
-                [(f'{{model="{m}"}}', c.value)
-                 for m, c in sorted(self.requests_total.items())] or
-                [("", 0)])
-        counter("serve_errors_total", "Requests that raised",
-                [(f'{{model="{m}"}}', c.value)
-                 for m, c in sorted(self.errors_total.items())] or
-                [("", 0)])
-        counter("serve_overload_total",
-                "Requests fast-failed at admission control",
-                [("", self.overload_total.value)])
-        counter("serve_rows_total", "Rows predicted (pre-padding)",
-                [("", self.rows_total.value)])
-        counter("serve_batches_total", "Coalesced kernel calls",
-                [("", self.batches_total.value)])
-        counter("serve_swaps_total", "Model hot-swaps",
-                [("", self.swaps_total.value)])
-        counter("serve_rollbacks_total", "Model rollbacks",
-                [("", self.rollbacks_total.value)])
-        summary("serve_batch_rows", "Rows per coalesced batch",
-                self.batch_rows)
-        summary("serve_queue_wait_seconds",
-                "Enqueue to batch start", self.queue_wait_s)
-        summary("serve_compute_seconds",
-                "Kernel call duration", self.compute_s)
+        render_counter(out, "serve_requests_total",
+                       "Accepted predict requests",
+                       [(f'{{model="{m}"}}', c.value)
+                        for m, c in sorted(self.requests_total.items())] or
+                       [("", 0)])
+        render_counter(out, "serve_errors_total", "Requests that raised",
+                       [(f'{{model="{m}"}}', c.value)
+                        for m, c in sorted(self.errors_total.items())] or
+                       [("", 0)])
+        render_counter(out, "serve_overload_total",
+                       "Requests fast-failed at admission control",
+                       [("", self.overload_total.value)])
+        render_counter(out, "serve_rows_total",
+                       "Rows predicted (pre-padding)",
+                       [("", self.rows_total.value)])
+        render_counter(out, "serve_batches_total", "Coalesced kernel calls",
+                       [("", self.batches_total.value)])
+        render_counter(out, "serve_swaps_total", "Model hot-swaps",
+                       [("", self.swaps_total.value)])
+        render_counter(out, "serve_rollbacks_total", "Model rollbacks",
+                       [("", self.rollbacks_total.value)])
+        render_summary(out, "serve_batch_rows", "Rows per coalesced batch",
+                       self.batch_rows)
+        render_summary(out, "serve_queue_wait_seconds",
+                       "Enqueue to batch start", self.queue_wait_s)
+        render_summary(out, "serve_compute_seconds",
+                       "Kernel call duration", self.compute_s)
         out.append("# HELP serve_rows_per_s Window throughput")
         out.append("# TYPE serve_rows_per_s gauge")
         out.append(f"serve_rows_per_s {self.rows_per_s():.9g}")

@@ -116,6 +116,8 @@ class ModelRegistry:
                 hist.append(old)
                 del hist[:-self.history]
                 self.metrics.swaps_total.inc()
+                from ..telemetry.events import record_serving
+                record_serving("swap", name, mv.version)
             # the publish: one reference store, atomic under the GIL —
             # in-flight readers keep `old`, new resolves see `mv`
             self._active[name] = mv
@@ -139,6 +141,8 @@ class ModelRegistry:
             mv = hist.pop()
             self._active[name] = mv
             self.metrics.rollbacks_total.inc()
+            from ..telemetry.events import record_serving
+            record_serving("rollback", name, mv.version)
         return mv
 
     def unregister(self, name: str):

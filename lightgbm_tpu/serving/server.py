@@ -36,6 +36,7 @@ from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from ..telemetry.core import MetricsRegistry
 from .batcher import MicroBatcher, Overloaded
 from .metrics import ServingMetrics
 from .registry import ModelRegistry
@@ -57,11 +58,18 @@ class PredictionServer:
                  max_batch_rows: int = 1024, max_wait_us: int = 2000,
                  max_queue_rows: Optional[int] = None,
                  min_bucket: int = 16,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 telemetry: Optional[MetricsRegistry] = None):
         self.metrics = metrics or ServingMetrics()
         self.registry = registry or ModelRegistry(metrics=self.metrics)
         if registry is not None and registry.metrics is not self.metrics:
             registry.metrics = self.metrics
+        # the unified registry (telemetry/core.py): serving's families
+        # mount as a collector, so /metrics here is one registry render
+        # — identical bytes when no other families are registered, and
+        # a shared registry (e.g. in-process training) composes both
+        self.telemetry = telemetry or MetricsRegistry()
+        self.telemetry.register_collector("serving", self.metrics.render)
         self.host, self.port = host, int(port)
         self._batcher_opts = dict(max_batch_rows=int(max_batch_rows),
                                   max_wait_us=int(max_wait_us),
@@ -182,7 +190,7 @@ class _Handler(BaseHTTPRequestHandler):
             except LookupError:
                 self._send_json(503, {"status": "no model registered"})
         elif path == "/metrics":
-            self._send(200, app.metrics.render().encode(),
+            self._send(200, app.telemetry.render().encode(),
                        "text/plain; version=0.0.4")
         elif path == "/models":
             self._send_json(200, {"models": app.registry.models(),

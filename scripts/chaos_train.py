@@ -20,6 +20,13 @@ cell (param set) on deterministic synthetic data:
   ``NumericDivergenceError``; ``nan_guard=rollback`` (with a transient
   fault) must roll back to the last checkpoint, re-run, and finish
   byte-identical to the clean baseline.
+- **event-splice** — a run with the telemetry event log armed is
+  SIGKILLed and resumed; the resumed run must splice the log
+  (telemetry/events.py): iteration records identical to an
+  uninterrupted telemetry baseline (no duplicated, no skipped eval
+  point), a re-emitted run header carrying the same config
+  fingerprint, and a log that passes the ``monitor --check`` schema
+  self-check end to end.
 
 Cells cover fused/legacy drivers × serial/8-device mesh (both
 ``dp_hist_merge`` modes) with bagging + quantized gradients enabled —
@@ -278,6 +285,48 @@ class Chaos:
             and payload2.get("eval_hist") == base["eval_hist"],
             f"rc={rc2}")
 
+    def event_splice(self, cell):
+        """A SIGKILLed run resumed in place must splice its event log:
+        same iteration records as an uninterrupted telemetry baseline,
+        one fingerprint across the re-emitted run headers, schema-clean
+        under the monitor --check validator."""
+        if _probe.REPO_ROOT not in sys.path:
+            sys.path.insert(0, _probe.REPO_ROOT)
+        from lightgbm_tpu.telemetry.events import (check_records,
+                                                   read_events)
+        params = dict(self._params(cell), event_log="run.events.jsonl")
+        d0 = os.path.join(self.root, cell.replace("/", "_"), "ev_base")
+        os.makedirs(d0, exist_ok=True)
+        payload, rc = self._run_child(cell, params, d0)
+        ev0 = os.path.join(d0, "run.events.jsonl")
+        ok0 = payload is not None and os.path.exists(ev0)
+        base_recs = read_events(ev0) if ok0 else []
+        base_iters = [r["iter"] for r in base_recs
+                      if r["event"] == "iteration"]
+        self.check(f"{cell} event-log baseline",
+                   ok0 and not check_records(base_recs)
+                   and bool(base_iters), f"rc={rc}")
+        if not ok0:
+            return
+        d = os.path.join(self.root, cell.replace("/", "_"), "ev_kill")
+        os.makedirs(d, exist_ok=True)
+        # hard death mid-run (torn tail territory), then resume in place
+        self._run_child(cell, params, d,
+                        extra={"LIGHTGBM_TPU_CHAOS_KILL_ITER": "5",
+                               "LIGHTGBM_TPU_CHAOS_KILL_SIGNAL": "KILL"})
+        resumed, rc2 = self._run_child(cell, params, d)
+        recs = read_events(os.path.join(d, "run.events.jsonl"))
+        headers = [r for r in recs if r["event"] == "run_header"]
+        iters = [r["iter"] for r in recs if r["event"] == "iteration"]
+        problems = check_records(recs)
+        self.check(
+            f"{cell} event-log splice (no dup/skip, one fingerprint)",
+            resumed is not None and not problems
+            and iters == base_iters and len(headers) >= 2
+            and len({h["fingerprint"] for h in headers}) == 1,
+            f"rc={rc2} iters={iters} vs base={base_iters} "
+            f"headers={len(headers)} problems={problems[:3]}")
+
     # -- driver --------------------------------------------------------
 
     def run_cell(self, cell, kills):
@@ -295,6 +344,7 @@ class Chaos:
                 self.corrupt(cell, base, kill_dir, "truncate")
                 self.corrupt(cell, base, kill_dir, "all")
         self.poison(cell, base)
+        self.event_splice(cell)
 
     def run(self, cells, kills=None):
         if kills is None:

@@ -4,8 +4,13 @@
 Runs the static-analysis passes (``lightgbm_tpu/analysis/``) over the
 repo's hot-path entry points — fused boosting step, data-parallel tree
 builder, packed-ensemble predict walk, serving micro-batcher — for
-every canonical config cell (plain / EFB / quantized / categorical ×
-serial / data-parallel) on the 8-virtual-device CPU mesh. Exit 0 when
+every canonical config cell (plain / EFB / quantized / categorical /
+multiclass / nan_guard / telemetry × serial / data-parallel) on the
+8-virtual-device CPU mesh. The telemetry cell trains with the full
+observation stack armed (event log + live introspection server) and
+must lint identically — the subsystem's zero-host-callback contract
+(TD002) and the deferred guard flag (TD006) survive being watched.
+Exit 0 when
 every report is clean, 1 with a diagnostic when any error-severity
 finding survives.
 
