@@ -308,6 +308,22 @@ def probe_hist_impl(platform: str) -> dict:
         out.update(kernel_roofline_fields(platform, t_chosen, R, F, B, L))
     except Exception as e:
         print(f"roofline probe failed: {e}", file=sys.stderr)
+    # XLA's own price of the MXU formulation next to the analytical one
+    # (ISSUE 11): cost_analysis() of the compiled one-hot matmul build.
+    # The perf gate asserts the two FLOP counts agree within 2x.
+    try:
+        from lightgbm_tpu.telemetry.costmodel import hist_xla_cost
+        xc = hist_xla_cost(R, F, B, L, impl="matmul")
+        if xc.get("flops"):
+            out["hist_tflops_xla"] = round(
+                xc["flops"] / t_chosen / 1e12, 3)
+            out["hist_hbm_gbps_xla"] = round(
+                xc["bytes_accessed"] / t_chosen / 1e9, 2)
+            if out.get("hist_tflops"):
+                out["hist_flops_xla_ratio"] = round(
+                    out["hist_tflops_xla"] / out["hist_tflops"], 3)
+    except Exception as e:
+        print(f"xla cost probe failed: {e}", file=sys.stderr)
     return out
 
 
@@ -397,41 +413,56 @@ def ref_same_host_probe(X, y, Xv, yv, iters, max_bin) -> dict:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
-# bf16 matmul TFLOP/s and HBM GB/s peaks per chip generation (public
-# spec-sheet numbers; used only to contextualize the kernel timing)
-TPU_PEAKS = {"v4": (275.0, 1228.0), "v5e": (197.0, 819.0),
-             "v5p": (459.0, 2765.0), "v6": (918.0, 1640.0)}
+# Roofline accounting lives in the telemetry cost model now (ISSUE 11)
+# so live runs compute MFU/BW-utilization too; re-exported here for the
+# bench report's callers.
+from lightgbm_tpu.telemetry.costmodel import (  # noqa: E402
+    TPU_PEAKS, kernel_roofline_fields)
 
 
-def kernel_roofline_fields(platform: str, t_hist_s: float,
-                           R: int, F: int, B: int, L: int) -> dict:
-    """Derived FLOP/s + HBM bandwidth for one histogram build vs chip
-    peak (VERDICT r3 #1c — the numbers the >=5x-CUDA target is judged
-    on). FLOPs count the one-hot matmul as executed on the MXU
-    (2*R*(F*B)*(L*3)); bytes count the irreducible Pallas streams
-    (bins uint8 + gh f32 in, hist f32 out). On CPU the same fields are
-    emitted, labelled by `platform`, peak comparison omitted."""
-    flops = 2.0 * R * (F * B) * (L * HIST_CH_BENCH)
-    bytes_ = R * F + R * HIST_CH_BENCH * 4 + F * B * L * HIST_CH_BENCH * 4
-    out = {"hist_tflops": round(flops / t_hist_s / 1e12, 3),
-           "hist_hbm_gbps": round(bytes_ / t_hist_s / 1e9, 2)}
-    if platform == "tpu":
-        try:
-            import jax
-            kind = jax.devices()[0].device_kind.lower()
-            for k, (pf, pb) in TPU_PEAKS.items():
-                if k in kind:
-                    out["hist_mfu"] = round(out["hist_tflops"] / pf, 4)
-                    out["hist_hbm_util"] = round(
-                        out["hist_hbm_gbps"] / pb, 4)
-                    out["chip"] = kind
-                    break
-        except Exception:
-            pass
+def costmodel_fields(bst) -> dict:
+    """Compiled-program cost headline (ISSUE 11): XLA's flop/byte/peak
+    price of the staged programs, on the bench line next to the
+    measured timings they explain."""
+    from lightgbm_tpu.telemetry.costmodel import staged_cost_reports
+    out = {}
+    for label, rep in staged_cost_reports(bst).items():
+        out[f"cost_{label}_flops"] = round(rep.flops, 1)
+        out[f"cost_{label}_bytes"] = round(rep.bytes_accessed, 1)
+        out[f"cost_{label}_peak_bytes"] = rep.peak_bytes
     return out
 
 
-HIST_CH_BENCH = 3
+def phase_profile_fields(bst, iters: int = 4) -> dict:
+    """Device-time phase profile of the steady-state fused loop
+    (ISSUE 11): capture a few live iterations with jax.profiler, parse
+    the trace, and report per-phase *device* seconds per iteration —
+    the ground-truth counterpart of the host-side phase_s_per_iter_*
+    fields. BENCH_PROFILE=0 skips."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    from lightgbm_tpu.telemetry import costmodel, xprof
+    d = tempfile.mkdtemp(prefix="bench_prof_")
+    try:
+        jax.profiler.start_trace(d)
+        try:
+            for _ in range(iters):
+                bst.update(defer=True)
+            bst._gbdt.sync()
+        finally:
+            jax.profiler.stop_trace()
+        maps = costmodel.booster_phase_maps(bst)
+        prof = xprof.parse_trace(d, phase_maps=maps)
+        out = {f"phase_device_s_per_iter_{name}": round(v, 6)
+               for name, v in prof.device_s_per_iter(iters).items()}
+        out["device_busy_s_per_iter"] = round(
+            prof.device_busy_s / iters, 6)
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def serve_bench(bst, Xv) -> dict:
@@ -1257,6 +1288,21 @@ def main():
         except Exception as e:  # noqa: BLE001
             print(f"compile cache probe failed: {e}", file=sys.stderr)
 
+    cost_fields = {}
+    try:
+        cost_fields = costmodel_fields(bst)
+        print(f"cost model: {cost_fields}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — probes never kill bench
+        print(f"cost model probe failed: {e}", file=sys.stderr)
+
+    devphase_fields = {}
+    if os.environ.get("BENCH_PROFILE", "1") != "0":
+        try:
+            devphase_fields = phase_profile_fields(bst)
+            print(f"device phases: {devphase_fields}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"device phase profile failed: {e}", file=sys.stderr)
+
     serve_fields = {}
     if os.environ.get("BENCH_SERVE", "1") != "0":
         try:
@@ -1293,6 +1339,8 @@ def main():
         **res_fields,
         **tele_fields,
         **cc_fields,
+        **cost_fields,
+        **devphase_fields,
         **serve_fields,
         **ref_fields,
         **hist_fields,

@@ -24,7 +24,8 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 __all__ = ["HloOp", "DTYPE_BYTES", "COLLECTIVE_KINDS", "parse_ops",
-           "parse_collective_ops", "input_output_aliases", "lower_hlo"]
+           "parse_all_ops", "parse_collective_ops",
+           "input_output_aliases", "lower_hlo"]
 
 COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
                     "all-to-all", "collective-permute")
@@ -39,6 +40,12 @@ DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
 _SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
 _NAME_RE = re.compile(r'op_name="([^"]*)"')
 _CCT_RE = re.compile(r'custom_call_target="([^"]*)"')
+# generic op line: `[ROOT] %instr.N = <out-spec> opcode(...)`; the `%`
+# sigil is optional (newer HLO dumps drop it), the out spec is either
+# one shape or a parenthesized tuple of shapes
+_LINE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[A-Za-z_][\w.-]*)\s*=\s*"
+    r"(?P<out>\([^)]*\)|\S+)\s+(?P<op>[\w-]+)\(")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -49,6 +56,7 @@ class HloOp:
     out_bytes: int                  # bytes of the op's RESULT (per chip)
     op_name: str                    # HLO metadata (named_scope prefixes)
     custom_call_target: str = ""    # for custom-call ops
+    name: str = ""                  # LHS instruction name (%name = ...)
 
 
 def _op_re(opcodes: Sequence[str]) -> re.Pattern:
@@ -90,6 +98,27 @@ def parse_ops(hlo_text: str, opcodes: Sequence[str],
                          out_bytes=nbytes,
                          op_name=nm.group(1) if nm else "",
                          custom_call_target=cct.group(1) if cct else ""))
+    return ops
+
+
+def parse_all_ops(hlo_text: str) -> List[HloOp]:
+    """Every op line of the module (all computations, fusions
+    included), with the LHS instruction ``name`` filled — the key the
+    profiler's trace events carry as ``hlo_op``, so this is what the
+    instruction→phase map (telemetry/costmodel.py) is built from."""
+    ops = []
+    for line in hlo_text.splitlines():
+        m = _LINE_RE.match(line)
+        if m is None:
+            continue
+        shapes, nbytes = shape_bytes(m.group("out"))
+        nm = _NAME_RE.search(line)
+        cct = _CCT_RE.search(line)
+        ops.append(HloOp(opcode=m.group("op"), shapes=shapes,
+                         out_bytes=nbytes,
+                         op_name=nm.group(1) if nm else "",
+                         custom_call_target=cct.group(1) if cct else "",
+                         name=m.group("name")))
     return ops
 
 

@@ -522,6 +522,7 @@ class GBDT:
         # host_syncs_per_iter field
         self._pending: List[Tuple] = []
         self._fused_jit = None
+        self._full_mask_cache: Optional[Tuple] = None
         self.host_sync_count = 0
 
         # numeric-divergence guard (resilience subsystem): the fused
@@ -1501,6 +1502,17 @@ class GBDT:
             for dd, b, rl in zip(self.valid_dd, vb, vr):
                 dd.bins, dd.row_leaf0 = b, rl
 
+    def _full_row_mask(self) -> jax.Array:
+        """All-real-rows bagging mask, ``(row_leaf0 >= 0)`` as f32,
+        cached by buffer identity — ``row_leaf0`` is static across
+        iterations, and recomputing eagerly cost two extra device
+        dispatches (greater_equal + convert) per fused iteration."""
+        rl0 = self.train_dd.row_leaf0
+        cached = self._full_mask_cache
+        if cached is None or cached[0] is not rl0:
+            self._full_mask_cache = (rl0, (rl0 >= 0).astype(jnp.float32))
+        return self._full_mask_cache[1]
+
     def _fused_dispatch(self):
         """Enqueue one fused iteration: a single jit dispatch, no host
         sync. Host-RNG inputs (bagging mask, feature mask) are drawn
@@ -1509,7 +1521,7 @@ class GBDT:
         it = self.iter_
         mask = self._host_bag_mask(it)
         if mask is None:
-            mask = (self.train_dd.row_leaf0 >= 0).astype(jnp.float32)
+            mask = self._full_row_mask()
         fmask = self._feature_mask()
         if (self._bins_cm is None and self.plan is None
                 and self._bundle_meta is None

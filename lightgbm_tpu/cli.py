@@ -229,9 +229,11 @@ def main(argv: Optional[List[str]] = None) -> int:
               " [--mode ...]\n"
               "       python -m lightgbm_tpu chaos [--fast] [--cell ...]\n"
               "       python -m lightgbm_tpu monitor <run_dir|events."
-              "jsonl> [--check]\n"
+              "jsonl> [--check] [--perf]\n"
+              "       python -m lightgbm_tpu perf-gate [--update] "
+              "[--skip-timing]\n"
               "tasks: train | predict | refit | save_binary | serve | "
-              "trace-doctor | chaos | monitor")
+              "trace-doctor | chaos | monitor | perf-gate")
         return 0
     # `python -m lightgbm_tpu serve model=...` — subcommand spelling of
     # task=serve (the reference CLI is key=value only; serve is ours)
@@ -242,24 +244,27 @@ def main(argv: Optional[List[str]] = None) -> int:
     if argv[0] in ("trace-doctor", "trace_doctor"):
         from .analysis.doctor import doctor_main
         return doctor_main(argv[1:])
-    # `chaos` — the fault-injection harness (scripts/chaos_train.py):
-    # kills training at arbitrary iterations, corrupts checkpoints,
-    # poisons gradients, and asserts bit-identical recovery
     # `monitor` — render a run-event log (telemetry/events.py) into a
-    # phase/throughput/faults report; `--check` is the schema self-check
+    # phase/throughput/faults report; `--check` is the schema
+    # self-check, `--perf` the profiler-capture phase tables
     if argv[0] == "monitor":
         from .telemetry.monitor import monitor_main
         return monitor_main(argv[1:])
-    if argv[0] == "chaos":
+    # `chaos` / `perf-gate` — repo-checkout harnesses under scripts/:
+    # chaos_train.py (fault injection + bit-identical recovery) and
+    # perf_gate.py (cost-model + timing vs PERF_BASELINE.json)
+    if argv[0] in ("chaos", "perf-gate", "perf_gate"):
         import importlib.util
+        fname = ("chaos_train.py" if argv[0] == "chaos"
+                 else "perf_gate.py")
         here = os.path.dirname(os.path.abspath(__file__))
-        path = os.path.join(os.path.dirname(here), "scripts",
-                            "chaos_train.py")
+        path = os.path.join(os.path.dirname(here), "scripts", fname)
         if not os.path.exists(path):
             raise SystemExit(
-                "chaos harness not found (scripts/chaos_train.py ships "
+                f"{argv[0]} harness not found (scripts/{fname} ships "
                 "with the repo checkout, not the installed package)")
-        spec = importlib.util.spec_from_file_location("chaos_train", path)
+        spec = importlib.util.spec_from_file_location(
+            fname[:-3], path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         return mod.main(argv[1:])

@@ -57,6 +57,7 @@ What the trace attributes, per layer:
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
@@ -160,21 +161,31 @@ class PhaseTotals:
 
     def __init__(self):
         self._acc: Dict[str, List[float]] = {}
+        # spans arrive from any thread that annotates — the training
+        # loop, serving threads, the telemetry HTTP server. The += on
+        # the accumulator list is a read-modify-write, NOT atomic under
+        # the GIL (the interpreter can switch between the read and the
+        # store), so concurrent spans would silently drop time.
+        self._lock = threading.Lock()
 
     def _record(self, name: str, dt: float) -> None:
-        ent = self._acc.setdefault(name, [0.0, 0])
-        ent[0] += dt
-        ent[1] += 1
+        with self._lock:
+            ent = self._acc.setdefault(name, [0.0, 0])
+            ent[0] += dt
+            ent[1] += 1
 
     def total_s(self, name: str) -> float:
-        return self._acc.get(name, [0.0, 0])[0]
+        with self._lock:
+            return self._acc.get(name, [0.0, 0])[0]
 
     def count(self, name: str) -> int:
-        return int(self._acc.get(name, [0.0, 0])[1])
+        with self._lock:
+            return int(self._acc.get(name, [0.0, 0])[1])
 
     def items(self) -> List[Tuple[str, float, int]]:
-        return [(k, v[0], int(v[1]))
-                for k, v in sorted(self._acc.items())]
+        with self._lock:
+            return [(k, v[0], int(v[1]))
+                    for k, v in sorted(self._acc.items())]
 
     def per_iteration(self, iterations: int) -> Dict[str, dict]:
         """{phase: {total_s, count, s_per_iter, spans_per_iter}} —
@@ -183,10 +194,11 @@ class PhaseTotals:
         class-batched span both aggregate to that iteration's build
         seconds."""
         it = max(int(iterations), 1)
-        return {k: {"total_s": v[0], "count": int(v[1]),
-                    "s_per_iter": v[0] / it,
-                    "spans_per_iter": v[1] / it}
-                for k, v in sorted(self._acc.items())}
+        with self._lock:
+            return {k: {"total_s": v[0], "count": int(v[1]),
+                        "s_per_iter": v[0] / it,
+                        "spans_per_iter": v[1] / it}
+                    for k, v in sorted(self._acc.items())}
 
     def render(self, iterations: Optional[int] = None) -> str:
         rows = []

@@ -106,6 +106,30 @@ class TelemetrySession:
         self.registry.gauge("train_uptime_seconds",
                             "Seconds since telemetry start",
                             fn=lambda: time.monotonic() - self._t0)
+        # roofline gauges (ISSUE 11): XLA's cost_analysis price of the
+        # compiled fused step × the measured iteration rate. The cost
+        # report and instruction→phase maps are built ON THE TRAINING
+        # THREAD at the first sync after a scrape asks for them
+        # (lower()-ing the fused jit from the HTTP thread would race a
+        # concurrent dispatch's trace-time attribute rebinding), so the
+        # first scrape reads 0 and arms the want-flag.
+        self._perf_want = False
+        self._cost_cache: Any = None      # None | False | CostReport
+        self._phase_maps: Dict[str, Dict[str, str]] = {}
+        self.registry.gauge(
+            "train_fused_flops_per_iter",
+            "XLA cost_analysis flops of one compiled fused step",
+            fn=lambda: self._cost_field("flops"))
+        self.registry.gauge(
+            "train_fused_bytes_per_iter",
+            "XLA cost_analysis bytes accessed of one fused step",
+            fn=lambda: self._cost_field("bytes_accessed"))
+        self._g_tflops = self.registry.gauge(
+            "train_achieved_tflops",
+            "Achieved TFLOP/s: fused-step flops x iteration rate")
+        self._g_mfu = self.registry.gauge(
+            "train_mfu",
+            "Achieved TFLOP/s vs chip peak (known TPU chips only)")
 
     @classmethod
     def from_config(cls, cfg, params: Dict[str, Any]
@@ -143,6 +167,53 @@ class TelemetrySession:
         gb = self._gb()
         return int(getattr(gb, "host_sync_count", 0)) if gb else 0
 
+    # -- cost model / phase maps (built at sync points only) ----------
+    def _cost_field(self, attr: str) -> float:
+        """Gauge fn: read the cached fused-step CostReport, arming the
+        want-flag on a miss (next on_sync builds; scrapes never
+        compile)."""
+        rep = self._cost_cache
+        if rep is None:
+            self._perf_want = True
+        return float(getattr(rep, attr, 0.0) or 0.0) if rep else 0.0
+
+    def phase_maps(self) -> Dict[str, Dict[str, str]]:
+        """Instruction→phase maps for trace captures. Same contract as
+        the gauges: cached-or-arm, never build off the training
+        thread."""
+        if not self._phase_maps:
+            self._perf_want = True
+        return dict(self._phase_maps)
+
+    def _build_perf(self) -> None:
+        """Build the fused-step CostReport + phase maps (training
+        thread, at a sync point). force=False: uses the driver's
+        already-traced jit, refuses to trigger a fresh trace."""
+        from . import costmodel
+        try:
+            compiled = costmodel.fused_compiled(self._booster,
+                                                force=False)
+        except Exception:  # noqa: BLE001 — perf extras never fault a run
+            compiled = None
+        if compiled is None:
+            self._cost_cache = False
+            return
+        try:
+            text = compiled.as_text()
+            self._cost_cache = costmodel.cost_report(
+                compiled, "fused_step", hlo_text=text)
+            mod, table = costmodel.instruction_phase_map(text)
+            if table:
+                self._phase_maps = {mod: table}
+            if self.events is not None:
+                rep = self._cost_cache
+                self.events.append(
+                    "cost_model", label="fused_step",
+                    flops=rep.flops, bytes_accessed=rep.bytes_accessed,
+                    peak_bytes=rep.peak_bytes, n_ops=rep.n_ops)
+        except Exception:  # noqa: BLE001
+            self._cost_cache = False
+
     # -- lifecycle (engine.train) --------------------------------------
     def begin_run(self, booster, cfg, params: Dict[str, Any],
                   fingerprint: Optional[str],
@@ -170,9 +241,16 @@ class TelemetrySession:
         self.device.start()
         self.device.sample()
         if self._want_port is not None:
+            capture_root = None
+            if self.events is not None and self.events.path:
+                capture_root = os.path.join(
+                    os.path.dirname(os.path.abspath(self.events.path))
+                    or ".", "traces")
             self.server = IntrospectionServer(
                 self.registry, event_log=self.events,
-                health_fn=self._health)
+                health_fn=self._health,
+                capture_root=capture_root,
+                phase_map_fn=self.phase_maps)
             self.port = self.server.start()
             log.info(f"telemetry: serving http://127.0.0.1:{self.port} "
                      "(/metrics /events /healthz /trace)")
@@ -239,6 +317,16 @@ class TelemetrySession:
         self._g_iter.set(iteration)
         if d_iter > 0:
             self._g_ms_tree.set(ms_tree)
+        if self._perf_want and self._cost_cache is None:
+            self._build_perf()
+        rep = self._cost_cache
+        if rep and d_iter > 0 and ms_tree > 0:
+            achieved = rep.flops / (ms_tree / 1e3) / 1e12
+            self._g_tflops.set(achieved)
+            from .costmodel import chip_peaks
+            peaks = chip_peaks()
+            if peaks is not None:
+                self._g_mfu.set(achieved / peaks[1])
         for (name, metric), value in [((n, m), v) for n, m, v, _ in
                                       (evals or [])]:
             self._g_metric.labels(name, metric).set(value)

@@ -1,0 +1,371 @@
+"""Compiled-program cost model: what XLA actually built, priced.
+
+The bench's roofline numbers (``hist_tflops``/``hist_hbm_gbps``) were
+hand-derived FLOP/byte formulas; this module prices the *compiled*
+programs instead, via ``Compiled.cost_analysis()`` /
+``memory_analysis()``, and cross-checks the analytical counts against
+XLA's. It covers the staged programs the trace doctor already builds —
+the fused boosting step, the data-parallel tree builder, the packed
+ensemble predict, the serving batcher rungs — and attributes a
+program's ops/result-bytes to the canonical phases of ``phases.py``
+through the ``op_name`` metadata (``jax.named_scope`` prefixes) that
+``analysis/hlo_walk.py`` parses.
+
+Also owns the chip peak table (``TPU_PEAKS``, moved out of bench.py)
+so live runs — not just the bench — can state MFU / bandwidth
+utilization, and the instruction→phase map (`instruction_phase_map`)
+the trace parser (``xprof.py``) uses to attribute CPU executor events
+that carry only ``{hlo_module, hlo_op}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..analysis.hlo_walk import parse_all_ops
+from .xprof import UNKNOWN, phase_of_path
+
+__all__ = ["TPU_PEAKS", "HIST_CH", "CostReport", "cost_report",
+           "instruction_phase_map", "module_name",
+           "fused_compiled", "booster_phase_maps",
+           "staged_cost_reports", "analytical_hist_counts",
+           "kernel_roofline_fields", "roofline_utilization",
+           "hist_xla_cost", "chip_peaks"]
+
+# bf16 matmul TFLOP/s and HBM GB/s peaks per chip generation (public
+# spec-sheet numbers; used only to contextualize measured timings)
+TPU_PEAKS = {"v4": (275.0, 1228.0), "v5e": (197.0, 819.0),
+             "v5p": (459.0, 2765.0), "v6": (918.0, 1640.0)}
+
+# histogram channels: (grad, hess, count)
+HIST_CH = 3
+
+_MODULE_RE = re.compile(r"^HloModule\s+([^\s,]+)", re.MULTILINE)
+_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.-]+)\s*\(.*\)\s*->"
+                      r"\s*.+\{\s*$")
+_CALLS_RE = re.compile(r"\b(?:calls|to_apply|body|condition)="
+                       r"%?([\w.-]+)")
+
+# opcodes that never execute (metadata / plumbing) — excluded from the
+# per-phase op/byte attribution so it reflects real work
+_NOOP_OPCODES = frozenset({"parameter", "constant", "tuple",
+                           "get-tuple-element", "bitcast"})
+
+
+def chip_peaks() -> Optional[Tuple[str, float, float]]:
+    """(device_kind, peak TFLOP/s, peak HBM GB/s) of device 0, when it
+    is a TPU generation the table knows; None elsewhere (CPU hosts)."""
+    try:
+        import jax
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — peaks are garnish, never fatal
+        return None
+    for k, (pf, pb) in TPU_PEAKS.items():
+        if k in kind:
+            return kind, pf, pb
+    return None
+
+
+# ----------------------------------------------------------------------
+# Analytical histogram-kernel counts (the formulas bench.py carried)
+
+def analytical_hist_counts(R: int, F: int, B: int,
+                           L: int) -> Tuple[float, float]:
+    """(flops, bytes) of one histogram build as hand-derived: FLOPs
+    count the one-hot matmul as executed on the MXU
+    (2·R·(F·B)·(L·CH)); bytes count the irreducible streams (bins
+    uint8 + gh f32 in, hist f32 out)."""
+    flops = 2.0 * R * (F * B) * (L * HIST_CH)
+    bytes_ = R * F + R * HIST_CH * 4 + F * B * L * HIST_CH * 4
+    return flops, bytes_
+
+
+def roofline_utilization(tflops: float, gbps: float) -> Dict[str, Any]:
+    """MFU / HBM utilization vs the chip peak, when on a known TPU."""
+    peaks = chip_peaks()
+    if peaks is None:
+        return {}
+    kind, pf, pb = peaks
+    return {"hist_mfu": round(tflops / pf, 4),
+            "hist_hbm_util": round(gbps / pb, 4),
+            "chip": kind}
+
+
+def kernel_roofline_fields(platform: str, t_hist_s: float,
+                           R: int, F: int, B: int, L: int) -> dict:
+    """Derived FLOP/s + HBM bandwidth for one histogram build vs chip
+    peak (VERDICT r3 #1c — the numbers the >=5x-CUDA target is judged
+    on). On CPU the same fields are emitted, labelled by `platform`,
+    peak comparison omitted."""
+    flops, bytes_ = analytical_hist_counts(R, F, B, L)
+    out = {"hist_tflops": round(flops / t_hist_s / 1e12, 3),
+           "hist_hbm_gbps": round(bytes_ / t_hist_s / 1e9, 2)}
+    if platform == "tpu":
+        out.update(roofline_utilization(out["hist_tflops"],
+                                        out["hist_hbm_gbps"]))
+    return out
+
+
+def hist_xla_cost(R: int, F: int, B: int, L: int, *,
+                  impl: str = "matmul",
+                  hist_dtype: str = "bfloat16") -> Dict[str, float]:
+    """XLA's own price of one histogram build: compile
+    ``ops.histogram.build_histograms`` at the given lattice and read
+    ``cost_analysis``. ``impl='matmul'`` is the formulation the
+    analytical count models (one-hot MXU matmul), so these two must
+    agree within 2x — the perf gate asserts it.
+
+    Compiled with ``block_rows=R`` (one block): ``cost_analysis``
+    prices a while-loop body ONCE regardless of trip count, so the
+    production row-chunked program under-reports total flops by the
+    number of blocks. The unchunked program does the same logical work
+    in straight-line HLO, which is what both the analytical count and
+    a measured wall-clock divide against."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.histogram import build_histograms
+    bins = jnp.zeros((R, F), jnp.uint8)
+    gh = jnp.zeros((R, HIST_CH), jnp.float32)
+    rl = jnp.zeros((R,), jnp.int32)
+    lids = jnp.arange(L, dtype=jnp.int32)
+
+    def fn(b, g, r, li):
+        return build_histograms(b, g, r, li, num_bins=B,
+                                hist_dtype=hist_dtype, impl=impl,
+                                block_rows=R)
+    compiled = jax.jit(fn).lower(bins, gh, rl, lids).compile()
+    ca = _cost_dict(compiled)
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
+
+
+# ----------------------------------------------------------------------
+# CostReport over one compiled program
+
+def _cost_dict(compiled) -> Dict[str, float]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 — backend may not implement it
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if isinstance(ca, dict) else {}
+
+
+@dataclasses.dataclass
+class CostReport:
+    """One compiled program, priced: XLA flop/byte totals, the memory
+    footprint, and per-phase attribution from op_name metadata."""
+    label: str
+    flops: float
+    transcendentals: float
+    bytes_accessed: float
+    argument_bytes: int
+    output_bytes: int
+    temp_bytes: int
+    peak_bytes: int                 # argument + output + temp
+    generated_code_bytes: int
+    n_ops: int
+    phase_ops: Dict[str, int]       # phase → executable op count
+    phase_bytes: Dict[str, int]     # phase → result bytes of those ops
+
+    def as_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        for k in ("flops", "transcendentals", "bytes_accessed"):
+            d[k] = round(float(d[k]), 1)
+        return d
+
+
+def cost_report(compiled, label: str = "program",
+                hlo_text: Optional[str] = None) -> CostReport:
+    """Price one ``Compiled`` (jax ``.lower(...).compile()`` result)."""
+    ca = _cost_dict(compiled)
+    mem = None
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001
+        pass
+
+    def _m(attr: str) -> int:
+        return int(getattr(mem, attr, 0) or 0) if mem is not None else 0
+
+    if hlo_text is None:
+        try:
+            hlo_text = compiled.as_text()
+        except Exception:  # noqa: BLE001
+            hlo_text = ""
+    phase_ops: Dict[str, int] = {}
+    phase_bytes: Dict[str, int] = {}
+    n_ops = 0
+    for op, _comp, ph in _resolved_phases(hlo_text or ""):
+        if op.opcode in _NOOP_OPCODES:
+            continue
+        n_ops += 1
+        ph = ph or UNKNOWN
+        phase_ops[ph] = phase_ops.get(ph, 0) + 1
+        phase_bytes[ph] = phase_bytes.get(ph, 0) + op.out_bytes
+    arg_b, out_b, tmp_b = (_m("argument_size_in_bytes"),
+                           _m("output_size_in_bytes"),
+                           _m("temp_size_in_bytes"))
+    return CostReport(
+        label=label,
+        flops=float(ca.get("flops", 0.0)),
+        transcendentals=float(ca.get("transcendentals", 0.0)),
+        bytes_accessed=float(ca.get("bytes accessed", 0.0)),
+        argument_bytes=arg_b, output_bytes=out_b, temp_bytes=tmp_b,
+        peak_bytes=arg_b + out_b + tmp_b,
+        generated_code_bytes=_m("generated_code_size_in_bytes"),
+        n_ops=n_ops,
+        phase_ops=dict(sorted(phase_ops.items())),
+        phase_bytes=dict(sorted(phase_bytes.items())))
+
+
+# ----------------------------------------------------------------------
+# Instruction → phase maps (xprof attribution path 2)
+
+def module_name(hlo_text: str) -> str:
+    m = _MODULE_RE.search(hlo_text or "")
+    return m.group(1) if m else ""
+
+
+def _resolved_phases(hlo_text: str):
+    """[(HloOp, computation, phase-or-None)] with hierarchical phase
+    resolution: an instruction's own ``op_name`` metadata wins; an
+    unannotated fusion/call takes the dominant phase of the computation
+    it calls; remaining compiler-generated plumbing (loop-carry copies,
+    induction arithmetic — XLA strips their metadata) inherits the
+    dominant phase of its enclosing computation. The hierarchy matters
+    on CPU, where while-loop-body micro-ops execute hundreds of
+    thousands of times and would otherwise all land in ``unknown``."""
+    comp = ""
+    rows: List[Tuple[Any, str, Optional[str], Optional[str]]] = []
+    votes: Dict[str, Dict[str, int]] = {}
+    for line in (hlo_text or "").splitlines():
+        mc = _COMP_RE.match(line)
+        if mc and "= " not in line.split("{")[0]:
+            comp = mc.group(1)
+            continue
+        parsed = parse_all_ops(line)
+        if not parsed:
+            continue
+        op = parsed[0]
+        own = phase_of_path(op.op_name)
+        calls = _CALLS_RE.findall(line)
+        rows.append((op, comp, own, calls[-1] if calls else None))
+        if own is not None:
+            v = votes.setdefault(comp, {})
+            v[own] = v.get(own, 0) + 1
+    dominant = {c: max(v, key=v.get) for c, v in votes.items() if v}
+    out = []
+    for op, c, own, callee in rows:
+        ph = own
+        if ph is None and callee is not None:
+            ph = dominant.get(callee)
+        if ph is None:
+            ph = dominant.get(c)
+        out.append((op, c, ph))
+    return out
+
+
+def instruction_phase_map(hlo_text: str
+                          ) -> Tuple[str, Dict[str, str]]:
+    """(module name, {instruction name → phase}) — the lookup table the
+    trace parser uses for executor events that name only ``hlo_op``.
+    Phases resolve hierarchically (see :func:`_resolved_phases`)."""
+    table: Dict[str, str] = {}
+    for op, _comp, ph in _resolved_phases(hlo_text):
+        if ph is not None and op.name:
+            table[op.name] = ph
+    return module_name(hlo_text), table
+
+
+# ----------------------------------------------------------------------
+# The staged programs (same set the trace doctor lints)
+
+def fused_compiled(bst, *, force: bool = True):
+    """The trainer's own compiled fused step (donation flags and all),
+    or None when the fused gate pins the legacy driver. ``force=False``
+    refuses to trigger a fresh trace/compile — the mode for calls off
+    the training thread, where ``_fused_step_entry``'s trace-time
+    attribute rebinding must not race a concurrent dispatch."""
+    from ..analysis.doctor import _fused_trace_args, _pin_fused
+    gb = getattr(bst, "_gbdt", None) or bst
+    with _pin_fused(True):
+        reason = gb._fused_gate_reason()
+    if reason:
+        return None
+    if gb._fused_jit is None:
+        if not force:
+            return None
+        gb._fused_dispatch()
+        gb.sync()
+    args = _fused_trace_args(gb)
+    return gb._fused_jit.lower(*args).compile()
+
+
+def booster_phase_maps(bst, compiled=None, *,
+                       force: bool = True) -> Dict[str, Dict[str, str]]:
+    """Phase maps for a trained booster's staged programs (today: the
+    fused step — the one whose CPU executor events need the lookup)."""
+    if compiled is None:
+        try:
+            compiled = fused_compiled(bst, force=force)
+        except Exception:  # noqa: BLE001 — maps are best-effort
+            compiled = None
+    if compiled is None:
+        return {}
+    mod, table = instruction_phase_map(compiled.as_text())
+    return {mod: table} if table else {}
+
+
+def staged_cost_reports(bst, *,
+                        batcher_rows: int = 16) -> Dict[str, CostReport]:
+    """CostReports over the staged programs of one trained booster:
+    the fused step (when the gate allows), the packed-ensemble predict,
+    one serving-batcher rung, and — on a multi-device host — the
+    data-parallel tree builder."""
+    import jax
+    import jax.numpy as jnp
+    reports: Dict[str, CostReport] = {}
+    compiled = fused_compiled(bst)
+    if compiled is not None:
+        reports["fused_step"] = cost_report(compiled, "fused_step")
+    from ..ops.predict_ensemble import _walk, pack_ensemble
+    ens = pack_ensemble(bst._trees)
+    F = bst.num_feature()
+    for label, rows in (("predict", 256), (f"batcher_b{batcher_rows}",
+                                           batcher_rows)):
+        X = jnp.zeros((rows, F), jnp.float32)
+        c = jax.jit(_walk).lower(ens, X).compile()
+        reports[label] = cost_report(c, label)
+    if len(jax.devices()) >= 2:
+        try:
+            reports["tree_builder"] = _tree_builder_report()
+        except Exception:  # noqa: BLE001 — mesh probe is best-effort
+            pass
+    return reports
+
+
+def _tree_builder_report(R: int = 256, F: int = 8,
+                         B: int = 16) -> CostReport:
+    import jax
+
+    from ..ops.split import SplitParams
+    from ..parallel.comms import _synthetic_inputs
+    from ..parallel.data_parallel import DataParallelPlan
+    plan = DataParallelPlan(hist_merge="reduce_scatter")
+    bins, gh, rl0, meta = _synthetic_inputs(R, F, B)
+    kw = dict(num_leaves=7, leaf_batch=4, max_depth=-1, num_bins=B,
+              hist_dtype="float32", block_rows=R // plan.num_shards,
+              split_params=SplitParams(min_data_in_leaf=2,
+                                       min_sum_hessian_in_leaf=1e-3))
+
+    def fn(b, g, rl):
+        return plan.build_tree(b, g, rl, *meta, **kw)[0]
+    sharded = (plan.shard_bins(bins), plan.shard_rows(gh),
+               plan.shard_rows(rl0))
+    c = jax.jit(fn).lower(*sharded).compile()
+    return cost_report(c, "tree_builder")

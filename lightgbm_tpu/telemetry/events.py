@@ -52,6 +52,8 @@ EVENT_TYPES: Dict[str, tuple] = {
     "log": ("level", "msg"),
     "serving": ("action", "model"),
     "train_end": ("iter", "trees", "wall_s"),
+    "cost_model": ("label", "flops", "bytes_accessed"),
+    "perf_gate": ("status", "checked", "failed"),
 }
 
 

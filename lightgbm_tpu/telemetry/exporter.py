@@ -11,8 +11,14 @@ port), serving:
 - ``GET /events?n=`` — tail of the run-event log as JSONL.
 - ``GET /healthz``  — run liveness: current iteration, trees, state.
 - ``GET /trace?duration_ms=`` — on-demand ``jax.profiler`` capture of
-  the next N ms into a fresh directory; the response names it, for
-  ``tensorboard --logdir`` / Perfetto. One capture at a time.
+  the next N ms. The response carries the parsed per-phase device/host
+  summary (``xprof.parse_trace``) plus the capture dir for
+  ``tensorboard --logdir`` / Perfetto / ``monitor --perf``. Captures
+  land as numbered ``capture_NNNN`` dirs under one tracked root with
+  keep-last-N retention (older captures pruned, nothing leaks), the
+  session's instruction→phase map is saved alongside as
+  ``phase_map.json``, and a failed ``stop_trace`` returns a 500 error
+  body — never a 200 naming a dangling dir. One capture at a time.
 - ``SIGUSR1`` — dump the metrics snapshot + phase totals through
   ``log.info`` (the kill -USR1 runbook for a run with no port open).
 
@@ -25,19 +31,27 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import signal
 import tempfile
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Callable, Optional
+from typing import Callable, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from .core import MetricsRegistry
 from .events import EventLog
 
-__all__ = ["IntrospectionServer", "install_sigusr1"]
+__all__ = ["IntrospectionServer", "CaptureError", "install_sigusr1"]
 
 _MAX_TRACE_MS = 60_000
+
+
+class CaptureError(RuntimeError):
+    """A profiler capture failed AFTER starting (stop_trace raised) —
+    distinct from the 409 capture-already-running RuntimeError so the
+    handler can answer 500 with the failure instead of a dangling
+    log_dir."""
 
 
 class IntrospectionServer:
@@ -46,14 +60,31 @@ class IntrospectionServer:
     def __init__(self, registry: MetricsRegistry,
                  event_log: Optional[EventLog] = None,
                  health_fn: Optional[Callable[[], dict]] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 capture_root: Optional[str] = None,
+                 phase_map_fn: Optional[
+                     Callable[[], Dict[str, Dict[str, str]]]] = None,
+                 keep_captures: int = 4):
         self.registry = registry
         self.event_log = event_log
         self.health_fn = health_fn
         self.host, self.port = host, int(port)
+        # profiler captures nest under one tracked root as
+        # capture_NNNN dirs with keep-last-N retention; the telemetry
+        # session points this at <run dir>/traces so monitor --perf
+        # finds them next to the event log
+        self.capture_root = capture_root
+        # returns the session's instruction→phase maps, saved next to
+        # each capture as phase_map.json. MUST only hand back maps
+        # already built at a training sync point — building one lowers
+        # the fused jit, and doing that from this HTTP thread would
+        # race a concurrent dispatch's trace-time attribute rebinding.
+        self.phase_map_fn = phase_map_fn
+        self.keep_captures = max(1, int(keep_captures))
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._trace_lock = threading.Lock()
+        self._capture_seq = 0
 
     def start(self) -> int:
         """Bind + serve from a daemon thread; returns the bound port."""
@@ -95,22 +126,67 @@ class IntrospectionServer:
             self._thread.join(timeout=10)
             self._thread = None
 
+    def _capture_dir(self) -> str:
+        if self.capture_root is None:
+            self.capture_root = tempfile.mkdtemp(
+                prefix="lgbtpu_traces_")
+        os.makedirs(self.capture_root, exist_ok=True)
+        self._capture_seq += 1
+        d = os.path.join(self.capture_root,
+                         f"capture_{self._capture_seq:04d}")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _prune_captures(self) -> None:
+        try:
+            caps = sorted(e for e in os.listdir(self.capture_root)
+                          if e.startswith("capture_"))
+        except OSError:
+            return
+        for stale in caps[:-self.keep_captures]:
+            shutil.rmtree(os.path.join(self.capture_root, stale),
+                          ignore_errors=True)
+
     def capture_trace(self, duration_ms: int) -> dict:
-        """Synchronous jax.profiler capture of the next N ms."""
+        """Synchronous jax.profiler capture of the next N ms, parsed
+        into the per-phase device/host summary before answering."""
         import time
 
         import jax
+
+        from . import xprof
         duration_ms = max(1, min(int(duration_ms), _MAX_TRACE_MS))
         if not self._trace_lock.acquire(blocking=False):
             raise RuntimeError("a trace capture is already running")
         try:
-            log_dir = tempfile.mkdtemp(prefix="lgbtpu_trace_")
+            log_dir = self._capture_dir()
             jax.profiler.start_trace(log_dir)
             try:
                 time.sleep(duration_ms / 1e3)
             finally:
-                jax.profiler.stop_trace()
-            return {"log_dir": log_dir, "duration_ms": duration_ms}
+                try:
+                    jax.profiler.stop_trace()
+                except Exception as e:  # noqa: BLE001
+                    # a 200 naming this dir would hand the caller a
+                    # capture that was never serialized
+                    shutil.rmtree(log_dir, ignore_errors=True)
+                    raise CaptureError(
+                        f"stop_trace failed: {type(e).__name__}: {e}"
+                    ) from e
+            self._prune_captures()
+            resp = {"log_dir": log_dir, "duration_ms": duration_ms}
+            try:
+                maps = self.phase_map_fn() if self.phase_map_fn else {}
+                if maps:
+                    xprof.save_phase_map(log_dir, maps)
+                prof = xprof.parse_trace(log_dir,
+                                         phase_maps=maps or None)
+                resp.update(prof.summary_dict())
+            except Exception as e:  # noqa: BLE001 — the capture is
+                # still on disk and usable offline even if parsing it
+                # inline failed
+                resp["parse_error"] = f"{type(e).__name__}: {e}"
+            return resp
         finally:
             self._trace_lock.release()
 
@@ -161,6 +237,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, app.capture_trace(ms))
             else:
                 self._send_json(404, {"error": f"unknown path {path}"})
+        except CaptureError as e:
+            self._send_json(500, {"error": str(e)})
         except RuntimeError as e:
             self._send_json(409, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — a scrape must not kill

@@ -23,7 +23,8 @@ from typing import Any, Dict, List, Optional
 
 from .events import check_records, read_events
 
-__all__ = ["monitor_main", "find_event_logs", "render_report"]
+__all__ = ["monitor_main", "find_event_logs", "render_report",
+           "find_captures", "render_perf"]
 
 
 def find_event_logs(target: str) -> List[str]:
@@ -126,6 +127,59 @@ def render_report(path: str, records: List[Dict[str, Any]]) -> str:
     return "\n".join(out)
 
 
+def find_captures(target: str) -> List[str]:
+    """Profiler capture dirs associated with a run: ``<run_dir>/traces/
+    capture_NNNN`` (where the telemetry server lands them), or
+    ``target`` itself when it directly holds ``capture_*`` dirs or is a
+    single capture dir."""
+    if not os.path.isdir(target):
+        return []
+    for root in (os.path.join(target, "traces"), target):
+        caps = sorted(glob.glob(os.path.join(root, "capture_*")))
+        caps = [c for c in caps if os.path.isdir(c)]
+        if caps:
+            return caps
+    # a capture dir itself (holds plugins/profile/... trace files)
+    if glob.glob(os.path.join(target, "**", "*.trace.json*"),
+                 recursive=True):
+        return [target]
+    return []
+
+
+def render_perf(capture: str,
+                records: Optional[List[Dict[str, Any]]] = None) -> str:
+    """``monitor --perf``: device-vs-host phase table of one capture
+    (``xprof.parse_trace`` with the saved ``phase_map.json``), crossed
+    against the event log's measured ms/tree when one is available.
+
+    The comparison target is the log's UNPROFILED steady-state ms/tree
+    — on CPU the per-event tracing tax inflates the profiled wall
+    clock, so the capture's own step span is not an honest baseline."""
+    from . import xprof
+    out: List[str] = [f"-- capture {capture} --"]
+    try:
+        prof = xprof.parse_trace(capture)
+    except (FileNotFoundError, ValueError) as e:
+        return "\n".join(out + [f"  unparseable: {e}"])
+    out.append(prof.render())
+    dev_iter = prof.device_s_per_iter()
+    fused_ms = sum(v for k, v in dev_iter.items()) * 1e3
+    ms = [r.get("ms_per_tree", 0.0) for r in (records or [])
+          if r.get("event") == "iteration" and r.get("ms_per_tree")]
+    if fused_ms > 0 and ms:
+        mean_ms = sum(ms) / len(ms)
+        out.append(
+            f"  phase device sum {fused_ms:.2f} ms/iter vs event-log "
+            f"ms/tree mean {mean_ms:.2f} "
+            f"(ratio {fused_ms / mean_ms:.3f}; <1 means host-side "
+            "time the device never saw, >1 means tracing overhead "
+            "landed inside op windows)")
+    elif fused_ms > 0:
+        out.append(f"  phase device sum {fused_ms:.2f} ms/iter "
+                   "(no event log to compare against)")
+    return "\n".join(out)
+
+
 def monitor_main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m lightgbm_tpu monitor",
@@ -138,8 +192,36 @@ def monitor_main(argv: Optional[List[str]] = None) -> int:
                     help="events-schema self-check: validate every "
                          "record and the ordering invariants; rc=1 on "
                          "any problem")
+    ap.add_argument("--perf", action="store_true",
+                    help="parse the run's profiler captures "
+                         "(<run_dir>/traces/capture_*) into "
+                         "device-vs-host phase tables and compare the "
+                         "fused phase sum against the event log's "
+                         "measured ms/tree")
     ns = ap.parse_args(argv)
     paths = find_event_logs(ns.target)
+    if ns.perf:
+        captures = find_captures(ns.target if os.path.isdir(ns.target)
+                                 else os.path.dirname(ns.target) or ".")
+        if not captures and not paths:
+            print(f"no captures or event logs under {ns.target!r} "
+                  "(looked for traces/capture_* and *.events.jsonl)")
+            return 1
+        records: List[Dict[str, Any]] = []
+        for path in paths:
+            try:
+                records.extend(read_events(path))
+            except ValueError:
+                pass  # --perf only borrows ms/tree; --check owns schema
+        if not captures:
+            print(f"no profiler captures under {ns.target!r} — "
+                  "capture one via GET /trace?duration_ms=... or "
+                  "profiler.trace()")
+            return 1
+        for cap in captures:
+            print(render_perf(cap, records))
+            print()
+        return 0
     if not paths:
         print(f"no event logs found under {ns.target!r} "
               "(looked for *.events.jsonl / events.jsonl)")

@@ -1,0 +1,487 @@
+"""Device-time phase profiles from ``jax.profiler`` captures.
+
+``collect_phase_totals`` (profiler.py) reports host wall-clock; the
+roadmap's kernel work is judged on *device* time. This module parses
+the trace-event JSON a capture leaves behind (``profiler.trace``, the
+``/trace`` endpoint, ``jax.profiler.start_trace``) and attributes
+device op time to the canonical phase set of ``phases.py``, yielding
+numbers comparable across the fused/legacy drivers and serial/mesh
+modes: per-phase device seconds, device-vs-host overlap, and dispatch
+gaps per iteration.
+
+Attribution runs three paths, in priority order, per device op event:
+
+1. **Name prefix** — on TPU device tracks the op/``long_name`` carries
+   the ``jax.named_scope`` path ("jit(f)/build/one_hot/dot_general"),
+   so the first path component that is a canonical phase wins. This is
+   the zero-setup path on real device timelines.
+2. **Instruction map** — CPU (and some GPU) executor events carry only
+   ``{hlo_module, hlo_op}`` args, no scope prefix. A *phase map* —
+   ``{module_name: {instruction_name: phase}}`` built from the compiled
+   module's ``op_name`` metadata (``costmodel.instruction_phase_map``)
+   — recovers the phase. Captures taken through the telemetry server
+   save it as ``phase_map.json`` next to the trace so offline
+   ``monitor --perf`` gets fused-driver attribution for free.
+3. **Host-span overlap** — the legacy driver dispatches one program per
+   phase under a host ``TraceAnnotation`` span, so a device op's time
+   is attributed to whichever host phase span(s) it overlaps.
+
+Anything all three paths miss lands in the explicit ``unknown`` bucket
+— attribution never silently drops device time.
+
+Timestamps in trace-event JSON are microseconds. Device tracks are
+*mostly* flat (one event per op execution), but the CPU runtime also
+emits container events on the same threads — ``ThunkExecutor::
+Execute`` wrapping a whole dispatch, ``while.N``/``call.N`` thunks
+wrapping every body-op execution — so naive duration sums double-count
+(a while loop's time lands once on the while event and again on its
+276k body events). Each thread is therefore processed as a containment
+stack: only *top-level* events count, events covered by an
+already-counted ancestor are skipped, and pure runtime wrappers
+(``ThunkExecutor``) are transparent — never counted themselves, their
+children visible. Counting the ``while.N`` event rather than its body
+ops also captures the loop's intra-body gaps, which is what makes the
+phase sums comparable to wall-clock ``ms_per_tree``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..phases import KNOWN_PHASES
+
+__all__ = ["PhaseProfile", "parse_trace", "find_trace_files",
+           "load_trace_events", "save_phase_map", "load_phase_map",
+           "find_phase_map", "PHASE_MAP_NAME", "UNKNOWN"]
+
+PHASE_MAP_NAME = "phase_map.json"
+UNKNOWN = "unknown"
+
+_STEP_NAME = "boost_iter"
+
+Interval = Tuple[float, float]
+
+
+# ----------------------------------------------------------------------
+# Loading
+
+def find_trace_files(source: str) -> List[str]:
+    """Trace-event JSON files for a capture. ``source`` may be a trace
+    file itself, a profiler log dir (``<dir>/plugins/profile/<ts>/
+    <host>.trace.json.gz``), or a run dir holding several capture
+    dirs — every ``*.trace.json[.gz]`` below it is returned (one per
+    host; a multi-host capture merges)."""
+    if os.path.isfile(source):
+        return [source]
+    if not os.path.isdir(source):
+        return []
+    hits: List[str] = []
+    for pat in ("**/*.trace.json.gz", "**/*.trace.json"):
+        hits.extend(glob.glob(os.path.join(source, pat), recursive=True))
+    return sorted(set(hits))
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """The ``traceEvents`` list of one trace-event JSON file
+    (gzipped or plain)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        obj = json.load(f)
+    if isinstance(obj, list):
+        return obj
+    return list(obj.get("traceEvents") or [])
+
+
+def save_phase_map(log_dir: str, maps: Dict[str, Dict[str, str]]) -> str:
+    """Write ``{module: {instruction: phase}}`` next to a capture so
+    offline parsers attribute CPU/GPU executor events."""
+    path = os.path.join(log_dir, PHASE_MAP_NAME)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(maps, f, sort_keys=True)
+    return path
+
+
+def load_phase_map(path: str) -> Dict[str, Dict[str, str]]:
+    with open(path, "r", encoding="utf-8") as f:
+        obj = json.load(f)
+    return {str(m): {str(k): str(v) for k, v in (ops or {}).items()}
+            for m, ops in (obj or {}).items()}
+
+
+def find_phase_map(trace_file: str,
+                   max_up: int = 4) -> Dict[str, Dict[str, str]]:
+    """Walk up from a trace file looking for ``phase_map.json`` (the
+    capture root is a few levels above ``plugins/profile/<ts>/``)."""
+    d = os.path.dirname(os.path.abspath(trace_file))
+    for _ in range(max_up):
+        cand = os.path.join(d, PHASE_MAP_NAME)
+        if os.path.isfile(cand):
+            try:
+                return load_phase_map(cand)
+            except (OSError, ValueError):
+                return {}
+        parent = os.path.dirname(d)
+        if parent == d:
+            break
+        d = parent
+    return {}
+
+
+# ----------------------------------------------------------------------
+# Interval helpers (all in seconds)
+
+def _union(intervals: List[Interval]) -> List[Interval]:
+    if not intervals:
+        return []
+    out: List[Interval] = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _total(intervals: List[Interval]) -> float:
+    return sum(e - s for s, e in intervals)
+
+
+def _intersect(a: List[Interval], b: List[Interval]) -> List[Interval]:
+    out: List[Interval] = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if s < e:
+            out.append((s, e))
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+# ----------------------------------------------------------------------
+# Phase attribution
+
+def phase_of_path(name: str) -> Optional[str]:
+    """First canonical phase along a scope path: ``jit(f)/build/dot``
+    → ``build``. Path components may carry trailing disambiguators
+    (``build_1``, ``build.2``) which do not match — named_scope emits
+    the raw phase string, so only exact components count."""
+    for part in str(name).replace(":", "/").split("/"):
+        if part in KNOWN_PHASES:
+            return part
+    return None
+
+
+def _event_phase(name: str, args: Dict[str, Any],
+                 phase_maps: Dict[str, Dict[str, str]]
+                 ) -> Optional[str]:
+    ph = phase_of_path(name)
+    if ph is not None:
+        return ph
+    for key in ("long_name", "tf_op", "name"):
+        v = args.get(key)
+        if v:
+            ph = phase_of_path(v)
+            if ph is not None:
+                return ph
+    if phase_maps and ("hlo_op" in args or "hlo_module" in args):
+        mod = str(args.get("hlo_module", ""))
+        table = phase_maps.get(mod)
+        if table is None and len(phase_maps) == 1:
+            table = next(iter(phase_maps.values()))
+        if table is not None:
+            # executor events name the instruction either in args
+            # (hlo_op) or as the event name itself
+            for key in (args.get("hlo_op"), name):
+                ph = table.get(str(key)) if key else None
+                if ph in KNOWN_PHASES:
+                    return ph
+    return None
+
+
+# ----------------------------------------------------------------------
+# The profile
+
+@dataclasses.dataclass
+class PhaseProfile:
+    """Parsed per-phase device/host time of one capture (or of several
+    merged trace files)."""
+    device_phase_s: Dict[str, float]           # merged across devices
+    per_device: Dict[str, Dict[str, float]]    # device → phase → s
+    host_phase_s: Dict[str, float]             # host TraceAnnotation
+    device_busy_s: float      # union of device-busy time, summed/device
+    host_phase_busy_s: float  # union of host phase spans
+    overlap_s: float          # device busy ∩ host phase spans
+    dispatch_gap_s: float     # device idle inside boost_iter windows
+    steps: int                # boost_iter step markers in the capture
+    step_span_s: float        # union of the step windows
+    n_events: int
+    sources: List[str]
+
+    def iterations(self) -> int:
+        return self.steps
+
+    def device_s_per_iter(self,
+                          iterations: Optional[int] = None
+                          ) -> Dict[str, float]:
+        """Per-phase device seconds per boost iteration (the number
+        comparable to ``ms_per_tree``). Uses the capture's own
+        ``boost_iter`` step count unless overridden."""
+        it = int(iterations if iterations is not None else self.steps)
+        if it <= 0:
+            return {}
+        return {k: v / it for k, v in self.device_phase_s.items()}
+
+    def summary_dict(self) -> Dict[str, Any]:
+        """JSON-ready summary (the ``/trace`` response body)."""
+        d = {
+            "device_phase_s": {k: round(v, 6) for k, v in
+                               sorted(self.device_phase_s.items())},
+            "host_phase_s": {k: round(v, 6) for k, v in
+                             sorted(self.host_phase_s.items())},
+            "devices": sorted(self.per_device),
+            "device_busy_s": round(self.device_busy_s, 6),
+            "overlap_s": round(self.overlap_s, 6),
+            "dispatch_gap_s": round(self.dispatch_gap_s, 6),
+            "steps": self.steps,
+            "n_events": self.n_events,
+        }
+        per_iter = self.device_s_per_iter()
+        if per_iter:
+            d["device_s_per_iter"] = {k: round(v, 6)
+                                      for k, v in sorted(per_iter.items())}
+            d["dispatch_gap_s_per_iter"] = round(
+                self.dispatch_gap_s / max(self.steps, 1), 6)
+        return d
+
+    def render(self) -> str:
+        """Device-vs-host per-phase table for ``monitor --perf``."""
+        rows = [f"devices: {', '.join(sorted(self.per_device)) or '-'}"
+                f"  steps: {self.steps}  events: {self.n_events}"]
+        names = sorted(set(self.device_phase_s) | set(self.host_phase_s))
+        if names:
+            rows.append(f"  {'phase':<12} {'device ms':>12} "
+                        f"{'host ms':>12}"
+                        + (f" {'device ms/iter':>16}" if self.steps
+                           else ""))
+            for name in names:
+                dv = self.device_phase_s.get(name, 0.0) * 1e3
+                hv = self.host_phase_s.get(name, 0.0) * 1e3
+                line = f"  {name:<12} {dv:12.3f} {hv:12.3f}"
+                if self.steps:
+                    line += f" {dv / self.steps:16.4f}"
+                rows.append(line)
+        rows.append(f"  device busy {self.device_busy_s * 1e3:.3f} ms, "
+                    f"host∩device overlap {self.overlap_s * 1e3:.3f} ms, "
+                    f"dispatch gap {self.dispatch_gap_s * 1e3:.3f} ms"
+                    + (f" ({self.dispatch_gap_s / self.steps * 1e3:.3f}"
+                       " ms/iter)" if self.steps else ""))
+        return "\n".join(rows)
+
+
+def _is_wrapper(name: str) -> bool:
+    """Pure runtime wrapper events: they cover whole dispatches on the
+    same thread as the op events, carry no phase of their own, and
+    would double-count everything beneath them."""
+    return "ThunkExecutor" in name
+
+
+def _is_device_thread(pname: str, tname: str) -> Optional[str]:
+    """Device label for a (process, thread) track, or None for host
+    tracks. TPU/GPU device processes are ``/device:TPU:0``-style; their
+    step/module summary lines are excluded (op lines carry the time).
+    On CPU there is no device process — the XLA executor threads
+    (``tf_XLATfrtCpuClient...``) are the closest thing to a device
+    timeline and merge into one ``cpu:0`` track."""
+    low_t = tname.lower()
+    if "/device:" in pname:
+        if "step" in low_t or "module" in low_t:
+            return None
+        return pname.split("/device:", 1)[1] or pname
+    if tname.startswith("tf_XLA") and "codegen" not in low_t \
+            and "llvm" not in low_t:
+        # the CPU runtime's executor + Eigen pool threads
+        # (tf_XLATfrtCpuClient/..., tf_XLAEigen/...) — compile-time
+        # codegen threads excluded
+        return "cpu:0"
+    return None
+
+
+def parse_trace(source: str,
+                phase_maps: Optional[Dict[str, Dict[str, str]]] = None
+                ) -> PhaseProfile:
+    """Parse one capture (file, log dir, or run dir — every trace file
+    found under ``source`` merges into one profile). ``phase_maps``
+    overrides the per-capture ``phase_map.json`` discovery."""
+    files = find_trace_files(source)
+    if not files:
+        raise FileNotFoundError(f"no trace-event JSON under {source!r}")
+    dev_phase: Dict[str, Dict[str, float]] = {}
+    host_phase: Dict[str, float] = {}
+    host_spans: List[Tuple[float, float, str]] = []
+    dev_busy: Dict[str, List[Interval]] = {}
+    pending: List[Tuple[str, float, float, float]] = []
+    thread_evs: Dict[Tuple[str, Any, Any],
+                     List[Tuple[float, float, str, Dict[str, Any]]]] = {}
+    step_iv: List[Interval] = []
+    step_count = 0
+    n_events = 0
+
+    for path in files:
+        maps = phase_maps if phase_maps is not None \
+            else find_phase_map(path)
+        events = load_trace_events(path)
+        procs: Dict[Any, str] = {}
+        threads: Dict[Tuple[Any, Any], str] = {}
+        for ev in events:
+            if ev.get("ph") == "M":
+                args = ev.get("args") or {}
+                if ev.get("name") == "process_name":
+                    procs[ev.get("pid")] = str(args.get("name", ""))
+                elif ev.get("name") == "thread_name":
+                    threads[(ev.get("pid"), ev.get("tid"))] = \
+                        str(args.get("name", ""))
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            n_events += 1
+            try:
+                ts_us = float(ev["ts"])
+                dur_us = float(ev.get("dur", 0.0))
+            except (KeyError, TypeError, ValueError):
+                continue
+            ts, dur = ts_us / 1e6, dur_us / 1e6
+            pname = procs.get(ev.get("pid"), "")
+            tname = threads.get((ev.get("pid"), ev.get("tid")), "")
+            name = str(ev.get("name", ""))
+            args = ev.get("args") or {}
+            dev = _is_device_thread(pname, tname)
+            if dev is not None:
+                dev_busy.setdefault(dev, []).append((ts, ts + dur))
+                # containment below works on the RAW microsecond
+                # values: integer-tick timestamps are exact there,
+                # while seconds round — back-to-back ops would
+                # float-drift into "covered by the previous op"
+                thread_evs.setdefault(
+                    (dev, ev.get("pid"), ev.get("tid")), []).append(
+                        (ts_us, dur_us, name, args))
+                continue
+            # host track: canonical-phase TraceAnnotation spans and
+            # boost_iter step markers
+            if name in KNOWN_PHASES:
+                host_phase[name] = host_phase.get(name, 0.0) + dur
+                host_spans.append((ts, ts + dur, name))
+            elif name == _STEP_NAME or name.startswith(_STEP_NAME):
+                step_count += 1
+                step_iv.append((ts, ts + dur))
+        # Per-thread containment pass: sort by (start, -dur) so a
+        # container sorts before the events it covers; an event under
+        # an already-counted ancestor is skipped (its time is covered),
+        # runtime wrappers are transparent.
+        for (dev, _pid, _tid), evs in thread_evs.items():
+            evs.sort(key=lambda t: (t[0], -t[1]))
+            bucket = dev_phase.setdefault(dev, {})
+            # (ts, dur, covered-by-children micros) per live wrapper —
+            # the uncovered remainder is thunk-scheduling self-time,
+            # real device time no op event accounts for
+            wrappers: List[List[float]] = []
+            stack: List[Tuple[float, bool, Optional[int]]] = []
+            for ts_us, dur_us, name, args in evs:
+                while stack and stack[-1][0] <= ts_us:
+                    stack.pop()
+                covered = any(counted for _, counted, _ in stack)
+                if _is_wrapper(name):
+                    widx: Optional[int] = None
+                    if not covered:
+                        for _, _, w in reversed(stack):
+                            if w is not None:
+                                # nested wrapper: its whole window is
+                                # covered from the outer one's view
+                                wrappers[w][2] += dur_us
+                                break
+                        widx = len(wrappers)
+                        wrappers.append([ts_us, dur_us, 0.0])
+                    stack.append((ts_us + dur_us, False, widx))
+                    continue
+                if covered:
+                    stack.append((ts_us + dur_us, True, None))
+                    continue
+                for _, _, w in reversed(stack):
+                    if w is not None:
+                        wrappers[w][2] += dur_us
+                        break
+                stack.append((ts_us + dur_us, True, None))
+                ph = _event_phase(name, args, maps or {})
+                if ph is not None:
+                    bucket[ph] = bucket.get(ph, 0.0) + dur_us / 1e6
+                elif dur_us > 0:
+                    pending.append((dev, ts_us / 1e6, dur_us / 1e6,
+                                    dur_us / 1e6))
+            for wts, wdur, wcov in wrappers:
+                # A wrapper mostly covered by its own thread's ops is a
+                # real execution window — its remainder is inter-thunk
+                # scheduling time. One mostly empty on its own thread
+                # is a dispatcher blocking on worker threads (the CPU
+                # client thread waiting on the Eigen pool): counting
+                # its time would double what the workers already
+                # recorded, so it is dropped.
+                self_us = wdur - wcov
+                if wdur > 0 and wcov / wdur >= 0.5 and self_us > 1e-3:
+                    pending.append((dev, wts / 1e6, wdur / 1e6,
+                                    self_us / 1e6))
+        thread_evs = {}
+
+    # Path 3: host-span overlap for still-unattributed device events
+    # (the legacy driver dispatches each phase inside its own host
+    # span, so a device op's window picks its phase by time).
+    spans_sorted = sorted(host_spans)
+    for dev, ts, dur, self_dur in pending:
+        end = ts + dur
+        remaining = self_dur
+        bucket = dev_phase.setdefault(dev, {})
+        for s, e, ph in spans_sorted:
+            if e <= ts:
+                continue
+            if s >= end or remaining <= 0:
+                break
+            ov = min(min(e, end) - max(s, ts), remaining)
+            if ov > 0:
+                bucket[ph] = bucket.get(ph, 0.0) + ov
+                remaining -= ov
+        if remaining > 1e-12:
+            bucket[UNKNOWN] = bucket.get(UNKNOWN, 0.0) + remaining
+
+    per_device = {d: dict(sorted(p.items()))
+                  for d, p in sorted(dev_phase.items())}
+    merged: Dict[str, float] = {}
+    for p in per_device.values():
+        for k, v in p.items():
+            merged[k] = merged.get(k, 0.0) + v
+    busy_unions = {d: _union(iv) for d, iv in dev_busy.items()}
+    busy_total = sum(_total(u) for u in busy_unions.values())
+    host_union = _union([(s, e) for s, e, _ in host_spans])
+    all_busy = _union([iv for u in busy_unions.values() for iv in u])
+    overlap = _total(_intersect(all_busy, host_union))
+    steps_union = _union(step_iv)
+    gap = max(_total(steps_union)
+              - _total(_intersect(all_busy, steps_union)), 0.0)
+    return PhaseProfile(
+        device_phase_s=dict(sorted(merged.items())),
+        per_device=per_device,
+        host_phase_s=dict(sorted(host_phase.items())),
+        device_busy_s=busy_total,
+        host_phase_busy_s=_total(host_union),
+        overlap_s=overlap,
+        dispatch_gap_s=gap,
+        steps=step_count,
+        step_span_s=_total(steps_union),
+        n_events=n_events,
+        sources=files)

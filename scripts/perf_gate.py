@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""CI perf-regression gate (``python -m lightgbm_tpu perf-gate``).
+
+Collects the canonical perf metrics and compares them against the
+committed ``PERF_BASELINE.json`` with per-metric tolerance bands
+(telemetry/perf.py). Two metric families:
+
+- **static** (always collected): XLA ``cost_analysis``/
+  ``memory_analysis`` prices of the staged programs — the histogram
+  probe lattice shared with bench.py, the fused training step, the
+  predict path — plus the XLA-vs-analytical histogram FLOP cross-check
+  ratio, which must stay within 2x in BOTH directions. These are
+  deterministic for a fixed config: any drift means the compiled
+  program changed and must be blessed deliberately via ``--update``.
+- **timing** (collected only on a quiet host, never with
+  ``--skip-timing``): steady-state ms/tree of the canonical workload,
+  measured over deferred updates after warmup. A baseline recorded on
+  a different host signature degrades timing to ``skip`` — wall-clock
+  numbers only gate against the machine that produced them.
+
+Exit 0 = gate passed; 1 = regression (or seeded regression detected);
+2 = no baseline and not ``--update``.
+
+``--seed-regression`` doubles every collected metric before comparing
+— the gate's own self-test (lint_static.sh asserts it exits non-zero).
+``--update`` rewrites the baseline from this run's numbers.
+``--event-log PATH`` appends a ``perf_gate`` record to that run log.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+# the gate must price programs, not race other jobs for an accelerator
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+# canonical workload: mirrors the validated observability demo shape —
+# fused driver, 31 leaves (host tree materialization stays off the
+# critical path), no eval sets
+N_ROWS, N_FEATS, NUM_LEAVES = 20_000, 16, 31
+WARMUP_ROUNDS, TIMED_ROUNDS = 8, 40
+# histogram probe lattice — identical to bench.probe_hist_impl so the
+# two surfaces gate the same program
+HIST_R, HIST_F, HIST_B, HIST_L = 1 << 17, 28, 63, 21
+
+
+def _canonical_booster():
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(N_ROWS, N_FEATS)).astype(np.float32)
+    y = (X[:, 0] + 0.25 * X[:, 1] - 0.5 * X[:, 2] > 0).astype(
+        np.float32)
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "verbosity": -1, "seed": 7}
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    return bst
+
+
+def collect_metrics(skip_timing: bool = False
+                    ) -> Tuple[Dict[str, float], List[str]]:
+    """(metrics, skipped_names). Static cost-model metrics always;
+    timing only on a quiet host."""
+    from lightgbm_tpu.telemetry import costmodel, perf
+
+    metrics: Dict[str, float] = {}
+    skipped: List[str] = []
+
+    # histogram lattice: XLA's price + the analytical cross-check
+    xla = costmodel.hist_xla_cost(HIST_R, HIST_F, HIST_B, HIST_L,
+                                  impl="matmul")
+    ana_flops, _ = costmodel.analytical_hist_counts(
+        HIST_R, HIST_F, HIST_B, HIST_L)
+    metrics["hist_flops_xla"] = float(xla["flops"])
+    metrics["hist_bytes_xla"] = float(xla["bytes_accessed"])
+    if ana_flops > 0 and xla["flops"] > 0:
+        metrics["hist_flops_xla_ratio"] = xla["flops"] / ana_flops
+
+    # staged-program prices of the canonical booster
+    bst = _canonical_booster()
+    for rep in costmodel.staged_cost_reports(bst).values():
+        metrics[f"cost_{rep.label}_flops"] = float(rep.flops)
+        metrics[f"cost_{rep.label}_bytes"] = float(rep.bytes_accessed)
+        if rep.label == "fused_step":
+            metrics["cost_fused_step_peak_bytes"] = float(
+                rep.peak_bytes)
+            metrics["cost_fused_step_n_ops"] = float(rep.n_ops)
+
+    # steady-state timing (quiet host only — loadavg says whether a
+    # wall-clock number would measure us or the neighbours)
+    if skip_timing:
+        skipped.append("ms_per_tree")
+    elif not perf.host_quiet():
+        print("perf-gate: host not quiet (loadavg); skipping timing",
+              file=sys.stderr)
+        skipped.append("ms_per_tree")
+    else:
+        gb = bst._gbdt
+        for _ in range(WARMUP_ROUNDS):
+            bst.update(defer=True)
+        gb.sync()
+        t0 = time.perf_counter()
+        for _ in range(TIMED_ROUNDS):
+            bst.update(defer=True)
+        gb.sync()
+        metrics["ms_per_tree"] = ((time.perf_counter() - t0) * 1e3
+                                  / TIMED_ROUNDS)
+    return metrics, skipped
+
+
+_TIMING_KINDS = ("time", "throughput")
+
+
+def _timing_metrics(names) -> List[str]:
+    from lightgbm_tpu.telemetry.perf import DEFAULT_TOLERANCES
+    return [n for n in names
+            if DEFAULT_TOLERANCES.get(n) is not None
+            and DEFAULT_TOLERANCES[n].kind in _TIMING_KINDS]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    from lightgbm_tpu.telemetry import perf
+
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu perf-gate",
+        description="Compare bench/cost-model metrics against the "
+                    "committed perf baseline.")
+    ap.add_argument("--baseline",
+                    default=os.path.join(_REPO, perf.BASELINE_NAME),
+                    help="baseline JSON path (default: repo root "
+                         f"{perf.BASELINE_NAME})")
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from this run "
+                         "(blessing an intentional change)")
+    ap.add_argument("--skip-timing", action="store_true",
+                    help="static cost-model metrics only")
+    ap.add_argument("--seed-regression", action="store_true",
+                    help="self-test: double every collected metric; "
+                         "the gate must exit non-zero")
+    ap.add_argument("--event-log", default=None,
+                    help="append a perf_gate record to this run-event "
+                         "log")
+    ns = ap.parse_args(argv)
+
+    metrics, skipped = collect_metrics(skip_timing=ns.skip_timing)
+    if ns.seed_regression:
+        metrics = {k: v * 2.0 for k, v in metrics.items()}
+
+    if ns.update:
+        perf.save_baseline(ns.baseline, metrics, meta={
+            "workload": {"rows": N_ROWS, "feats": N_FEATS,
+                         "num_leaves": NUM_LEAVES,
+                         "timed_rounds": TIMED_ROUNDS},
+            "hist_lattice": {"R": HIST_R, "F": HIST_F, "B": HIST_B,
+                             "L": HIST_L},
+        })
+        print(f"perf baseline written: {ns.baseline} "
+              f"({len(metrics)} metrics)")
+        return 0
+
+    try:
+        base = perf.load_baseline(ns.baseline)
+    except FileNotFoundError:
+        print(f"no perf baseline at {ns.baseline} — run with "
+              "--update to create one", file=sys.stderr)
+        return 2
+    except ValueError as e:
+        print(f"perf baseline unreadable: {e}", file=sys.stderr)
+        return 2
+
+    # wall-clock only gates against the machine that recorded it
+    if base.get("host") != perf.host_signature():
+        timing = _timing_metrics(base.get("metrics", {}))
+        fresh = [m for m in timing if m not in skipped]
+        if fresh:
+            print("perf-gate: baseline host signature differs; timing "
+                  f"metrics degraded to skip: {', '.join(fresh)}",
+                  file=sys.stderr)
+            skipped.extend(fresh)
+
+    result = perf.compare(metrics, base.get("metrics", {}),
+                          skipped=skipped)
+    print(result.render())
+
+    if ns.event_log:
+        from lightgbm_tpu.telemetry.events import EventLog
+        EventLog(ns.event_log).append(
+            "perf_gate",
+            status="pass" if result.ok else "fail",
+            checked=len(result.checks), failed=result.failed,
+            baseline=os.path.basename(ns.baseline))
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
