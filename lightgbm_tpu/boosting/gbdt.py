@@ -1562,8 +1562,15 @@ class GBDT:
         if not self._pending:
             return False
         pending, self._pending = self._pending, []
-        host = jax.device_get([(trees, cont, ok)
-                               for (_, _, trees, cont, ok) in pending])
+        try:
+            host = jax.device_get([(trees, cont, ok)
+                                   for (_, _, trees, cont, ok)
+                                   in pending])
+        except jax.errors.JaxRuntimeError as e:
+            # an XLA execution error surfacing at the ring drain means
+            # a device (or its collective partner) went away mid-step
+            from ..resilience.guards import DeviceLossError
+            raise DeviceLossError(pending[0][0], detail=str(e)) from e
         self.host_sync_count += 1
         bm = self.train_set.bin_mappers
         uf = self.train_set.used_features
@@ -1622,12 +1629,23 @@ class GBDT:
         fallback configs run the legacy loop eagerly either way.
         """
         self._maybe_chaos_poison()
-        if gradients is not None or hessians is not None \
-                or not self.fused_ok:
-            if self.sync():        # drain any deferred work first
-                return True
-            return self._train_one_iter_legacy(gradients, hessians)
-        self._fused_dispatch()
+        try:
+            self._maybe_chaos_devloss()
+            if gradients is not None or hessians is not None \
+                    or not self.fused_ok:
+                if self.sync():    # drain any deferred work first
+                    return True
+                return self._train_one_iter_legacy(gradients, hessians)
+            self._fused_dispatch()
+        except jax.errors.JaxRuntimeError as e:
+            # runtime failures from collectives/XLA at the dispatch
+            # site are device loss, not a bug in the traced program —
+            # type them so the supervisor (on_device_loss=degrade) can
+            # restore + re-plan instead of dying on a raw XLA error.
+            # (NumericDivergenceError is a plain RuntimeError and
+            # passes through untouched.)
+            from ..resilience.guards import DeviceLossError
+            raise DeviceLossError(self.iter_, detail=str(e)) from e
         if defer:
             return None
         return self.sync()
@@ -1654,6 +1672,32 @@ class GBDT:
         poisoned[0, 0] = np.nan
         self.scores = (self.plan.shard_scores(poisoned)
                        if self.plan is not None else jnp.asarray(poisoned))
+
+    def _maybe_chaos_devloss(self) -> None:
+        """Fault-injection hook (scripts/chaos_train.py): when armed
+        via LIGHTGBM_TPU_CHAOS_DEVLOSS_ITER, raise a real
+        ``jax.errors.JaxRuntimeError`` at the matching iteration —
+        exercising the same classify-and-retype path a genuine XLA
+        collective failure takes. LIGHTGBM_TPU_CHAOS_DEVLOSS_ONCE
+        (marker file) makes the fault transient; _DEVLOSS_MODE=mesh
+        fires only while a parallel plan is active, so shrink-to-serial
+        recovery can be proven. Inert (one env read) outside the
+        harness."""
+        import os
+        it_s = os.environ.get("LIGHTGBM_TPU_CHAOS_DEVLOSS_ITER")
+        if it_s is None or self.iter_ != int(it_s):
+            return
+        if (os.environ.get("LIGHTGBM_TPU_CHAOS_DEVLOSS_MODE") == "mesh"
+                and self.plan is None):
+            return
+        marker = os.environ.get("LIGHTGBM_TPU_CHAOS_DEVLOSS_ONCE")
+        if marker:
+            if os.path.exists(marker):
+                return      # already fired once; fault was transient
+            with open(marker, "w") as f:
+                f.write("device lost\n")
+        raise jax.errors.JaxRuntimeError(
+            "chaos: injected device loss (collective partner gone)")
 
     def _train_one_iter_legacy(self,
                                gradients: Optional[np.ndarray] = None,
@@ -1875,6 +1919,11 @@ class GBDT:
             "rng_feature": _rng_state_to_json(
                 self._rng_feature.get_state()),
             "has_bag_mask": self._bag_mask is not None,
+            # real-row counts: the saved score arrays are [K, r_pad]
+            # with topology-dependent padding; restore onto a different
+            # mesh keeps only these leading columns (elastic resume)
+            "num_data": int(self.train_dd.num_data),
+            "valid_num_data": [int(dd.num_data) for dd in self.valid_dd],
         }
         arrays = {"scores": np.asarray(self.scores)}
         for vi, vs in enumerate(self.valid_scores):
@@ -1888,9 +1937,17 @@ class GBDT:
         """Restore a :meth:`training_state` capture into this live
         instance. Trees replace ``models`` IN PLACE so the engine's
         ``Booster._trees`` alias keeps pointing at the live list; score
-        arrays are re-placed through the parallel plan's sharding so
-        mesh runs restore onto the same device layout they saved
-        from."""
+        arrays are re-placed through the parallel plan's sharding.
+
+        The capture's padded width is topology-dependent (serial pads
+        to the scan block, a rows-sharded plan to ``block * shards``),
+        so a checkpoint written on a different mesh arrives with the
+        wrong trailing padding. Padded rows are initialized once and
+        never mutated (``_update_score_impl`` gates on ``row_leaf >=
+        0``; the bagging mask sets only real-row indices), so elastic
+        restore is exact: keep the saved real-row columns, take the
+        padding from this instance's freshly-initialized arrays.
+        """
         if self.plan is not None and self.plan.multi_process:
             raise NotImplementedError(
                 "full-state checkpoint restore is single-process only")
@@ -1902,16 +1959,51 @@ class GBDT:
             _rng_state_from_json(state["rng_bagging"]))
         self._rng_feature.set_state(
             _rng_state_from_json(state["rng_feature"]))
+        rec_n = state.get("num_data")
+        if rec_n is not None and int(rec_n) != self.train_dd.num_data:
+            raise ValueError(
+                f"checkpoint was written for {rec_n} training rows, "
+                f"this run has {self.train_dd.num_data}: same config "
+                "fingerprint but a different dataset")
 
         def _place_scores(a):
             return (self.plan.shard_scores(a) if self.plan is not None
                     else jnp.asarray(a))
-        self.scores = _place_scores(arrays["scores"])
+
+        def _repad(saved, fresh, n):
+            # fresh init already carries the correct values for every
+            # padded row at THIS topology (init score broadcast); only
+            # the real rows carry trained state worth restoring
+            if saved.shape == fresh.shape:
+                return saved
+            merged = np.array(fresh, copy=True)
+            merged[..., :n] = saved[..., :n]
+            return merged
+
+        n = int(self.train_dd.num_data)
+        scores = _repad(arrays["scores"], np.asarray(self.scores), n)
+        if scores is not arrays["scores"]:
+            from .. import log as _log
+            shards = (self.plan.num_shards if self.plan is not None
+                      else 1)
+            _log.info(
+                "resume: re-sharding checkpoint state onto the current "
+                f"topology (saved scores {arrays['scores'].shape} -> "
+                f"{scores.shape}, {shards} shard(s))")
+        self.scores = _place_scores(scores)
         self.valid_scores = [
-            _place_scores(arrays[f"valid_scores_{vi}"])
+            _place_scores(_repad(arrays[f"valid_scores_{vi}"],
+                                 np.asarray(self.valid_scores[vi]),
+                                 int(self.valid_dd[vi].num_data)))
             for vi in range(len(self.valid_scores))]
         if state.get("has_bag_mask") and "bag_mask" in arrays:
             m = arrays["bag_mask"]
+            if m.shape[0] != scores.shape[-1]:
+                # padded-row mask entries are always zero on every
+                # topology (_host_bag_mask sets only real-row indices)
+                m2 = np.zeros(scores.shape[-1], m.dtype)
+                m2[:n] = m[:n]
+                m = m2
             self._bag_mask = (self.plan.shard_rows(m)
                               if self.plan is not None
                               else jnp.asarray(m))

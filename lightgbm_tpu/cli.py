@@ -94,9 +94,39 @@ def serve(params: Dict[str, str],
     server._bind()
     print(f"serving on http://{server.host}:{server.port} — endpoints: "
           "/predict /models /models/swap /models/rollback /healthz "
-          "/metrics")
+          "/healthz/alive /healthz/ready /metrics")
+    _install_drain_handler(server)
     server.serve_forever()
+    # the drain runs on a helper thread (see _install_drain_handler);
+    # wait for it so in-flight batcher work finishes before exit
+    t = getattr(server, "_drain_thread", None)
+    if t is not None:
+        t.join(timeout=60)
+        print("drained: in-flight work finished, exiting")
     return 0
+
+
+def _install_drain_handler(server) -> None:
+    """SIGTERM -> graceful drain. The handler runs on the main thread —
+    the same thread blocked inside ``serve_forever`` — and
+    ``httpd.shutdown()`` waits for that loop to exit, so the drain must
+    run on a helper thread; ``serve_forever`` then returns and the
+    process exits 0 once in-flight batcher work completes."""
+    import signal
+    import threading
+
+    def _on_term(signum, frame):
+        print("SIGTERM: draining (not-ready; finishing in-flight "
+              "work)", flush=True)
+        t = threading.Thread(target=server.drain, name="serve-drain",
+                             daemon=True)
+        server._drain_thread = t
+        t.start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass  # not on the main thread (embedded use) — skip
 
 
 def run(params: Dict[str, str]) -> int:

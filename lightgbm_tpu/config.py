@@ -385,6 +385,19 @@ PARAMS: Dict[str, ParamSpec] = {
                "NumericDivergenceError; rollback restores the newest "
                "valid checkpoint and re-runs with a logged incident "
                "(requires resume != off); off skips the check"),
+        _p("on_device_loss", "fail", str,
+           check=lambda v: v in ("fail", "degrade"),
+           doc="what engine.train does when a boosting step dies with "
+               "a typed DeviceLossError (an XLA/collective runtime "
+               "failure — a device went away): fail (default) "
+               "surfaces the error; degrade hands the run to the "
+               "supervising driver (resilience/supervisor.py), which "
+               "restores the newest checkpoint, retries with "
+               "exponential backoff, and after a repeat loss rebuilds "
+               "the plan on the surviving device set "
+               "(tree_learner=serial as the floor) — every transition "
+               "recorded in the telemetry event log as "
+               "degraded/reshard records. Forces resume=auto"),
         _p("linear_tree", False, bool, aliases=("linear_trees",)),
         _p("output_result", "LightGBM_predict_result.txt", str,
            aliases=("predict_result", "prediction_result", "predict_name",

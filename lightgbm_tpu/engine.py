@@ -1265,6 +1265,17 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     params = dict(params or {})
     cfg = Config(params)
     log.set_verbosity(int(cfg.verbosity))
+    if str(cfg.on_device_loss) == "degrade":
+        # supervised mode: each attempt re-enters train() with
+        # on_device_loss=fail (set by the supervisor), so this gate
+        # fires exactly once per user call
+        from .resilience.supervisor import supervised_train
+        return supervised_train(
+            train, params, train_set, num_boost_round,
+            valid_sets=valid_sets, valid_names=valid_names, feval=feval,
+            init_model=init_model,
+            keep_training_booster=keep_training_booster,
+            callbacks=callbacks, fobj=fobj)
     enable_compilation_cache()
     if "num_iterations" in cfg.explicit():  # any registered alias resolves
         num_boost_round = cfg.num_iterations
@@ -1351,7 +1362,7 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         NumericDivergenceError, PreemptionGuard, TrainingPreempted,
         checkpoint_path, config_fingerprint, find_resume_checkpoint,
         prune_numbered, read_checkpoint, restore_training_checkpoint,
-        write_training_checkpoint)
+        topology_descriptor, write_training_checkpoint)
     resume = str(cfg.resume)
     resume_on = resume != "off"
     nan_guard = str(cfg.nan_guard)
@@ -1370,8 +1381,10 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
     # run.
     cadence_base = init_iteration
 
+    reshard_from = None   # checkpoint topology, when it differed
+
     def _restore(state, arrays, texts):
-        nonlocal cadence_base, end_iteration
+        nonlocal cadence_base, end_iteration, reshard_from
         booster._ensure_gbdt()
         restore_training_checkpoint(booster, callbacks, state, arrays,
                                     texts)
@@ -1382,12 +1395,57 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                      f"end_iteration={rec_end} "
                      f"(num_boost_round ignored)")
             end_iteration = rec_end
+        # elastic resume: the checkpoint records the topology it was
+        # written under; when this process runs a different one the
+        # restore above already re-sharded — record the transition
+        rec_topo = state.get("topology")
+        cur_topo = topology_descriptor(booster._gbdt)
+        if rec_topo and rec_topo != cur_topo:
+            reshard_from = rec_topo
+            log.info(
+                "resume: topology changed since the checkpoint "
+                f"({rec_topo.get('parallel_mode')}x"
+                f"{rec_topo.get('num_shards')} "
+                f"{rec_topo.get('dp_hist_merge') or 'serial'} -> "
+                f"{cur_topo.get('parallel_mode')}x"
+                f"{cur_topo.get('num_shards')} "
+                f"{cur_topo.get('dp_hist_merge') or 'serial'}); "
+                "state re-sharded onto the current mesh")
 
-    def _write_ckpt(iteration: int) -> str:
+    # periodic checkpoint-write failures (ENOSPC, EROFS) must not kill
+    # a healthy run: warn + record, skip `streak - 1` boundaries as
+    # backoff, and only raise once _CKPT_FAIL_LIMIT consecutive writes
+    # failed. The preemption-path write stays fatal (the process is
+    # about to exit; losing that write loses the drained state).
+    _CKPT_FAIL_LIMIT = 3
+    ckpt_fail_streak = 0
+    ckpt_skip = 0
+
+    def _write_ckpt(iteration: int, final: bool = False):
+        nonlocal ckpt_fail_streak, ckpt_skip
+        if ckpt_skip > 0 and not final:
+            ckpt_skip -= 1
+            return None
         path = checkpoint_path(cfg.output_model, iteration)
-        write_training_checkpoint(
-            path, booster, callbacks, begin_iteration=cadence_base,
-            end_iteration=end_iteration, params=params)
+        try:
+            write_training_checkpoint(
+                path, booster, callbacks, begin_iteration=cadence_base,
+                end_iteration=end_iteration, params=params)
+        except OSError as e:
+            ckpt_fail_streak += 1
+            if final or ckpt_fail_streak >= _CKPT_FAIL_LIMIT:
+                raise
+            ckpt_skip = ckpt_fail_streak - 1
+            log.warning(
+                f"checkpoint write failed ({e}); continuing and "
+                f"retrying at a later snapshot boundary "
+                f"({ckpt_fail_streak}/{_CKPT_FAIL_LIMIT} consecutive "
+                "failures before this becomes fatal)")
+            if tele is not None:
+                tele.on_checkpoint("write", iteration, path, ok=False)
+            return None
+        ckpt_fail_streak = 0
+        ckpt_skip = 0
         prune_numbered(cfg.output_model + ".ckpt_iter_",
                        cfg.snapshot_keep)
         if tele is not None:
@@ -1422,6 +1480,9 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
         # fingerprint) so the resumed record chain reads uninterrupted
         tele.begin_run(booster, cfg, params, fingerprint,
                        resumed_from=resumed_from)
+        if reshard_from is not None:
+            tele.on_reshard(booster.current_iteration(), reshard_from,
+                            topology_descriptor(booster._gbdt))
 
     import os as _os
     chaos_kill_iter = _os.environ.get("LIGHTGBM_TPU_CHAOS_KILL_ITER")
@@ -1452,7 +1513,8 @@ def train(params: Dict, train_set: Dataset, num_boost_round: int = 100,
                 if guard.fired:
                     # SIGTERM/SIGINT: drain the pending device ring (the
                     # checkpoint capture syncs), persist, exit cleanly
-                    path = _write_ckpt(booster.current_iteration())
+                    path = _write_ckpt(booster.current_iteration(),
+                                       final=True)
                     if guard.deadline_exceeded():
                         log.warning("preemption drain exceeded the "
                                     f"{guard.deadline_s:g}s deadline")

@@ -25,26 +25,37 @@ Three concerns, one package:
   sync-free NaN/Inf flag the fused step carries next to its no-split
   stop flag reports non-finite gradients/scores (``nan_guard`` policy:
   ``raise`` surfaces it, ``rollback`` restores the newest valid
-  checkpoint and re-runs).
+  checkpoint and re-runs); :class:`DeviceLossError`, the typed form of
+  an XLA/collective runtime failure escaping a boosting step.
+- :mod:`.supervisor` — the ``on_device_loss=degrade`` retry loop:
+  restore the newest checkpoint, retry with exponential backoff, and
+  on a repeat loss rebuild the plan on the surviving device set
+  (``tree_learner=serial`` as the in-process floor). Checkpoints are
+  topology-portable (the model fingerprint excludes topology knobs and
+  the written topology is recorded as a descriptor), so the restore
+  re-shards scores/bag-mask state onto whatever mesh the retry — or a
+  fresh ``resume=auto`` process on fewer/more devices — builds.
 """
 
 from .atomic_io import atomic_write_bytes, atomic_write_text  # noqa: F401
-from .guards import NumericDivergenceError  # noqa: F401
+from .guards import DeviceLossError, NumericDivergenceError  # noqa: F401
 from .preemption import PreemptionGuard, TrainingPreempted  # noqa: F401
 from .checkpoint import (  # noqa: F401
     CheckpointError, checkpoint_path, config_fingerprint,
     find_resume_checkpoint, is_valid_checkpoint, list_numbered,
-    prune_numbered, read_checkpoint, write_checkpoint,
-    capture_training_checkpoint, restore_training_checkpoint,
-    write_training_checkpoint)
+    prune_numbered, read_checkpoint, topology_descriptor,
+    write_checkpoint, capture_training_checkpoint,
+    restore_training_checkpoint, write_training_checkpoint)
+from .supervisor import supervised_train  # noqa: F401
 
 __all__ = [
     "atomic_write_bytes", "atomic_write_text",
-    "NumericDivergenceError",
+    "DeviceLossError", "NumericDivergenceError",
     "PreemptionGuard", "TrainingPreempted",
     "CheckpointError", "checkpoint_path", "config_fingerprint",
     "find_resume_checkpoint", "is_valid_checkpoint", "list_numbered",
-    "prune_numbered", "read_checkpoint", "write_checkpoint",
-    "capture_training_checkpoint", "restore_training_checkpoint",
-    "write_training_checkpoint",
+    "prune_numbered", "read_checkpoint", "topology_descriptor",
+    "write_checkpoint", "capture_training_checkpoint",
+    "restore_training_checkpoint", "write_training_checkpoint",
+    "supervised_train",
 ]

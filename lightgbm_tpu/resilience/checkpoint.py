@@ -41,9 +41,9 @@ from .atomic_io import atomic_write_bytes
 __all__ = [
     "CheckpointError", "checkpoint_path", "config_fingerprint",
     "find_resume_checkpoint", "is_valid_checkpoint", "list_numbered",
-    "prune_numbered", "read_checkpoint", "write_checkpoint",
-    "capture_training_checkpoint", "restore_training_checkpoint",
-    "write_training_checkpoint",
+    "prune_numbered", "read_checkpoint", "topology_descriptor",
+    "write_checkpoint", "capture_training_checkpoint",
+    "restore_training_checkpoint", "write_training_checkpoint",
 ]
 
 _MAGIC = b"LGTPUCK1"
@@ -230,20 +230,61 @@ def find_resume_checkpoint(output_model: str,
 # that differed only in these is still resumable.
 _FINGERPRINT_EXCLUDE = frozenset({
     "resume", "output_model", "snapshot_freq", "snapshot_keep",
-    "nan_guard", "verbosity", "task", "data", "valid", "input_model",
-    "save_binary", "header", "label_column",
+    "nan_guard", "on_device_loss", "verbosity", "task", "data", "valid",
+    "input_model", "save_binary", "header", "label_column",
+})
+
+# Topology knobs: they decide WHERE the computation runs (plan, mesh,
+# merge collective), not WHAT it computes — serial/data-parallel and
+# allreduce/reduce_scatter produce bit-identical models. They are kept
+# out of the model fingerprint so a checkpoint written on an 8-device
+# data-parallel mesh resumes on 4 devices or serial (elastic resume);
+# the topology it was written under is recorded separately as a
+# descriptor (``topology_descriptor``) for the restore path to diff.
+_TOPOLOGY_EXCLUDE = frozenset({
+    "tree_learner", "num_machines", "dp_hist_merge", "machines",
+    "machine_list_filename", "local_listen_port", "time_out",
+    "feature_shard_storage",
 })
 
 
 def config_fingerprint(params: Dict[str, Any]) -> str:
-    """Short stable hash of the model-affecting training params."""
+    """Short stable hash of the model-affecting training params.
+
+    This is the MODEL fingerprint: learning params only. Topology
+    knobs (``_TOPOLOGY_EXCLUDE``) are excluded so the same logical job
+    resumed on a different mesh shape or tree learner still matches
+    its own checkpoints."""
     items = []
+    skip = _FINGERPRINT_EXCLUDE | _TOPOLOGY_EXCLUDE
     for k in sorted(params):
-        if k in _FINGERPRINT_EXCLUDE or callable(params[k]):
+        if k in skip or callable(params[k]):
             continue
         items.append((k, repr(params[k])))
     blob = json.dumps(items).encode("utf-8")
     return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def topology_descriptor(gbdt) -> Dict[str, Any]:
+    """Where a training run executes: tree learner, parallel plan mode,
+    mesh shape, and histogram-merge collective. Recorded next to (not
+    inside) the model fingerprint in every checkpoint, so restore can
+    tell "same model, different mesh" apart from "different model" —
+    and re-shard instead of refusing."""
+    import jax
+    plan = getattr(gbdt, "plan", None)
+    cfg = getattr(gbdt, "config", None)
+    return {
+        "tree_learner": str(getattr(cfg, "tree_learner", "serial")),
+        "parallel_mode": (str(getattr(plan, "parallel_mode", "serial"))
+                          if plan is not None else "serial"),
+        "num_shards": (int(getattr(plan, "num_shards", 1))
+                       if plan is not None else 1),
+        "num_devices": int(jax.device_count()),
+        "dp_hist_merge": (str(getattr(plan, "hist_merge", ""))
+                          if plan is not None else ""),
+        "num_machines": int(getattr(cfg, "num_machines", 1) or 1),
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -295,6 +336,7 @@ def capture_training_checkpoint(booster, callbacks: Sequence,
         "begin_iteration": int(begin_iteration),
         "end_iteration": int(end_iteration),
         "config_fingerprint": config_fingerprint(params),
+        "topology": topology_descriptor(booster._gbdt),
         "best_iteration": int(getattr(booster, "best_iteration", -1)),
         "best_score": getattr(booster, "best_score", None),
         "gbdt": gb_state,

@@ -19,7 +19,7 @@ Policy (``nan_guard`` config param):
 
 from __future__ import annotations
 
-__all__ = ["NumericDivergenceError"]
+__all__ = ["NumericDivergenceError", "DeviceLossError"]
 
 
 class NumericDivergenceError(RuntimeError):
@@ -30,3 +30,22 @@ class NumericDivergenceError(RuntimeError):
                f"{iteration}" + (f": {detail}" if detail else ""))
         super().__init__(msg)
         self.iteration = int(iteration)
+
+
+class DeviceLossError(RuntimeError):
+    """The runtime lost a device mid-step: an XLA execution error
+    (``jax.errors.JaxRuntimeError``) escaped the fused/legacy boosting
+    step or the sync-point ``device_get``. A healthy step never raises
+    it — collectives time out, HBM reads fail, or an interconnect
+    drops only when hardware goes away — so the step drivers in
+    ``boosting/gbdt.py`` convert any such escape into this typed error.
+    ``on_device_loss=degrade`` (resilience/supervisor.py) catches it,
+    restores the newest checkpoint, and rebuilds the plan on the
+    surviving device set; ``fail`` (default) surfaces it unchanged."""
+
+    def __init__(self, iteration: int, detail: str = ""):
+        msg = (f"device loss detected at iteration {iteration}"
+               + (f": {detail}" if detail else ""))
+        super().__init__(msg)
+        self.iteration = int(iteration)
+        self.detail = detail

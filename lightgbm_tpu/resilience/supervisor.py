@@ -1,0 +1,116 @@
+"""Supervised retry loop for device loss (``on_device_loss=degrade``).
+
+``engine.train`` delegates here when the config asks for degraded-mode
+survival. Each attempt is a full ``train()`` call with
+``on_device_loss=fail`` (so the inner run raises the typed
+:class:`~lightgbm_tpu.resilience.guards.DeviceLossError` instead of
+recursing) and ``resume=auto`` (so it restores the newest checkpoint —
+the topology-portable restore in ``GBDT.load_training_state`` re-shards
+the saved state onto whatever device set the retry builds its plan on).
+
+Retry ladder:
+
+1. First loss: retry on the SAME topology after a backoff — transient
+   faults (a flaky interconnect, a preempted collective) clear on
+   their own.
+2. Repeat loss: rebuild the plan on the surviving device set. In one
+   process JAX cannot shrink the visible device count after init, so
+   the in-process floor is ``tree_learner=serial`` (no collectives at
+   all); a true smaller mesh is a process restart away and is what the
+   chaos harness's elastic cells exercise.
+3. ``max_retries`` losses: give up and re-raise the last error.
+
+Every transition appends a ``degraded`` record to the run's event log
+(when one is configured) so ``python -m lightgbm_tpu monitor`` renders
+the fault history; the engine's restore path adds the ``reshard``
+record when the checkpoint's topology descriptor differs from the
+retry's.
+
+This module never imports ``engine`` (the package invariant:
+``engine`` imports resilience, not the reverse) — the engine passes
+its own ``train`` in as ``train_fn``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..log import info as log_info, warning as log_warning
+from .guards import DeviceLossError
+
+__all__ = ["supervised_train"]
+
+
+def _event_log_path(params: Dict[str, Any]) -> Optional[str]:
+    """Same event_log resolution as TelemetrySession.from_config, done
+    here without importing telemetry session machinery."""
+    from ..config import Config
+    cfg = Config(dict(params))
+    path = str(cfg.event_log).strip()
+    if path == "auto":
+        path = str(cfg.output_model) + ".events.jsonl"
+    return path or None
+
+
+def _record_degraded(params: Dict[str, Any], iteration: int,
+                     attempt: int, action: str, detail: str = "") -> None:
+    path = _event_log_path(params)
+    if path is None:
+        return
+    try:
+        from ..telemetry.events import EventLog
+        EventLog(path).append("degraded", iter=int(iteration),
+                              attempt=int(attempt), action=action,
+                              detail=detail[:200])
+    except Exception:  # noqa: BLE001 — observability never blocks retry
+        pass
+
+
+def supervised_train(train_fn: Callable, params: Dict[str, Any],
+                     train_set, num_boost_round: int = 100, *,
+                     max_retries: int = 3, backoff_base_s: float = 0.5,
+                     sleep: Callable[[float], None] = time.sleep,
+                     **kwargs):
+    """Run ``train_fn`` under device-loss supervision; returns its
+    Booster. ``kwargs`` pass through to every attempt unchanged."""
+    params = dict(params)
+    params["on_device_loss"] = "fail"   # the inner run raises, we catch
+    if str(params.get("resume", "off")) == "off":
+        log_warning("on_device_loss=degrade needs checkpoints to "
+                    "restore after a loss; forcing resume=auto")
+        params["resume"] = "auto"
+    attempt = 0
+    while True:
+        try:
+            return train_fn(params, train_set, num_boost_round, **kwargs)
+        except DeviceLossError as e:
+            attempt += 1
+            if attempt > max_retries:
+                _record_degraded(params, e.iteration, attempt,
+                                 "give_up", str(e))
+                log_warning(f"device loss: {max_retries} retries "
+                            "exhausted; surfacing the error")
+                raise
+            delay = backoff_base_s * (2 ** (attempt - 1))
+            if attempt >= 2 and str(params.get(
+                    "tree_learner", "serial")) != "serial":
+                # repeat loss on the same plan: assume the device set
+                # shrank for good and rebuild on the in-process floor
+                params["tree_learner"] = "serial"
+                action = "shrink_to_serial"
+                log_warning(
+                    f"device loss persisted ({e}); rebuilding the plan "
+                    "as tree_learner=serial and resuming from the "
+                    f"newest checkpoint (attempt {attempt}/"
+                    f"{max_retries}, backoff {delay:g}s)")
+            else:
+                action = "retry"
+                log_info(
+                    f"device loss ({e}); restoring the newest "
+                    f"checkpoint and retrying on the same topology "
+                    f"(attempt {attempt}/{max_retries}, backoff "
+                    f"{delay:g}s)")
+            _record_degraded(params, e.iteration, attempt, action,
+                             str(e))
+            sleep(delay)

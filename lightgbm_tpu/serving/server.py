@@ -21,8 +21,17 @@ Endpoints:
   ``{"name", "file"}`` hot-swaps (load + warmup off-path, then atomic
   publish); ``POST /models/rollback`` ``{"name"?}`` republishes the
   previous version.
-- ``GET /healthz`` — 200 once a model serves, 503 before.
+- ``GET /healthz/alive`` — 200 while the process serves HTTP at all
+  (liveness); ``GET /healthz`` / ``GET /healthz/ready`` — 200 once a
+  model serves AND the server is not draining, 503 otherwise
+  (readiness; a SIGTERM-draining server keeps answering alive=200 /
+  ready=503 until in-flight batcher work finishes).
 - ``GET /metrics`` — Prometheus text (field reference: metrics.py).
+
+Graceful drain: ``drain()`` (wired to SIGTERM by the CLI ``serve``
+path) flips readiness, stops accepting connections, finishes queued
+batcher work (``MicroBatcher.close(drain=True)``), then returns — so a
+rolling restart loses no accepted request.
 """
 
 from __future__ import annotations
@@ -79,6 +88,8 @@ class PredictionServer:
         self._block = threading.Lock()
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._stop_lock = threading.Lock()
+        self.draining = False
 
     # -- predict plumbing ---------------------------------------------
     def _batcher(self, name: str) -> MicroBatcher:
@@ -138,16 +149,35 @@ class PredictionServer:
         self.port = self._httpd.server_address[1]
 
     def stop(self):
-        httpd, self._httpd = self._httpd, None
+        """Idempotent shutdown: stop accepting, then close batchers.
+
+        Must not run on the thread inside ``serve_forever`` —
+        ``httpd.shutdown()`` blocks until that loop exits (deadlock);
+        the CLI's SIGTERM path calls ``drain()`` from a helper thread
+        for exactly this reason. Safe to call concurrently: state is
+        claimed under a lock, so the drain thread and
+        ``serve_forever``'s ``finally`` compose."""
+        with self._stop_lock:
+            httpd, self._httpd = self._httpd, None
+            thread, self._thread = self._thread, None
+            batchers = list(self._batchers.values())
+            self._batchers = {}
         if httpd is not None:
             httpd.shutdown()
             httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10)
-            self._thread = None
-        for b in self._batchers.values():
-            b.close()
-        self._batchers.clear()
+        if thread is not None:
+            thread.join(timeout=10)
+        for b in batchers:
+            # drain=True: queued requests are answered before the
+            # worker exits — accepted work is never dropped
+            b.close(drain=True)
+
+    def drain(self) -> None:
+        """Graceful drain (SIGTERM path): flip readiness so load
+        balancers route away, then stop — finishing in-flight batcher
+        work before returning."""
+        self.draining = True
+        self.stop()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -181,7 +211,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         app = self.server_app
         path = urlparse(self.path).path.rstrip("/") or "/"
-        if path == "/healthz":
+        if path == "/healthz/alive":
+            # liveness: the process answers HTTP — even while draining
+            self._send_json(200, {"status": "alive"})
+        elif path in ("/healthz", "/healthz/ready"):
+            if app.draining:
+                self._send_json(503, {"status": "draining"})
+                return
             try:
                 mv = app.registry.resolve()
                 self._send_json(200, {"status": "ok",

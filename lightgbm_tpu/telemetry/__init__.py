@@ -249,11 +249,25 @@ class TelemetrySession:
             self.server = IntrospectionServer(
                 self.registry, event_log=self.events,
                 health_fn=self._health,
+                port=int(self._want_port),
                 capture_root=capture_root,
                 phase_map_fn=self.phase_maps)
-            self.port = self.server.start()
-            log.info(f"telemetry: serving http://127.0.0.1:{self.port} "
-                     "(/metrics /events /healthz /trace)")
+            try:
+                self.port = self.server.start()
+            except OSError as e:
+                # fail open: a taken port (another run, a stale
+                # sidecar) must not kill a healthy training job — the
+                # exporter is observability, not a dependency
+                log.warning(
+                    f"telemetry: cannot bind exporter port "
+                    f"{self._want_port} ({e}); continuing without "
+                    "live introspection")
+                self.server = None
+                self.port = None
+            else:
+                log.info("telemetry: serving "
+                         f"http://127.0.0.1:{self.port} "
+                         "(/metrics /events /healthz /trace)")
         self._restore_sig = install_sigusr1(self.dump_to_log)
         self._started = True
         _SESSION = self
@@ -341,11 +355,21 @@ class TelemetrySession:
                                host_syncs=self._host_syncs())
 
     def on_checkpoint(self, action: str, iteration: int,
-                      path: str) -> None:
-        self._c_ckpt.labels(action).inc()
+                      path: str, ok: bool = True) -> None:
+        self._c_ckpt.labels(action if ok else f"{action}_failed").inc()
         if self.events is not None:
-            self.events.append("checkpoint", action=action,
-                               iter=iteration, path=path)
+            rec = {"action": action, "iter": iteration, "path": path}
+            if not ok:
+                rec["ok"] = False
+            self.events.append("checkpoint", **rec)
+
+    def on_reshard(self, iteration: int, from_topo: Dict[str, Any],
+                   to_topo: Dict[str, Any]) -> None:
+        """Elastic resume re-sharded checkpoint state onto a different
+        topology (called right after begin_run, already synced)."""
+        if self.events is not None:
+            self.events.append("reshard", iter=iteration,
+                               **{"from": from_topo, "to": to_topo})
 
     def on_preemption(self, signum: int, iteration: int) -> None:
         if self.events is not None:

@@ -48,6 +48,11 @@ EVENT_TYPES: Dict[str, tuple] = {
     "preemption": ("signum", "iter"),
     "nan_guard": ("iter", "policy"),
     "resume": ("iter", "path"),
+    # elastic resilience: a resume re-sharded checkpoint state onto a
+    # different topology; the supervisor retried/degraded after a
+    # device loss. Fault records — the resume splice keeps them.
+    "reshard": ("iter", "from", "to"),
+    "degraded": ("iter", "attempt", "action"),
     "early_stop": ("iter", "best_iter"),
     "log": ("level", "msg"),
     "serving": ("action", "model"),

@@ -42,6 +42,16 @@ def find_event_logs(target: str) -> List[str]:
     return []
 
 
+def _topo_str(t: Any) -> str:
+    """Compact one-line form of a checkpoint topology descriptor."""
+    if not isinstance(t, dict):
+        return str(t)
+    merge = t.get("dp_hist_merge") or ""
+    return (f"{t.get('tree_learner', '?')}x{t.get('num_shards', '?')}"
+            + (f"/{merge}" if merge else "")
+            + f" ({t.get('num_devices', '?')} dev)")
+
+
 def _fmt_bytes(n: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB"):
         if abs(n) < 1024 or unit == "GiB":
@@ -105,9 +115,22 @@ def render_report(path: str, records: List[Dict[str, Any]]) -> str:
         elif ev == "checkpoint" and r.get("action") == "restore":
             faults.append(f"checkpoint restore to iteration "
                           f"{r.get('iter')}")
+        elif ev == "checkpoint" and r.get("ok") is False:
+            faults.append(f"checkpoint {r.get('action', 'write')} "
+                          f"FAILED at iteration {r.get('iter')} "
+                          "(run continued)")
         elif ev == "resume":
             faults.append(f"resumed at iteration {r.get('iter')} from "
                           f"{os.path.basename(str(r.get('path')))}")
+        elif ev == "reshard":
+            faults.append(
+                f"resharded at iteration {r.get('iter')}: "
+                f"{_topo_str(r.get('from'))} -> "
+                f"{_topo_str(r.get('to'))}")
+        elif ev == "degraded":
+            faults.append(
+                f"device loss at iteration {r.get('iter')}: "
+                f"{r.get('action')} (attempt {r.get('attempt')})")
         elif ev == "log" and r.get("level") == "warning":
             faults.append(f"warning: {str(r.get('msg'))[:90]}")
     writes = sum(1 for r in records if r["event"] == "checkpoint"

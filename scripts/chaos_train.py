@@ -27,12 +27,22 @@ cell (param set) on deterministic synthetic data:
   point), a re-emitted run header carrying the same config
   fingerprint, and a log that passes the ``monitor --check`` schema
   self-check end to end.
+- **elastic** (``--elastic``) — topology-portable resume: SIGKILL a
+  run on mesh/plan topology A, resume the same directory on topology B
+  (different virtual-device count, serial<->data-parallel,
+  allreduce<->reduce_scatter) and compare against an uninterrupted
+  baseline run entirely at B. Quantized cells (int32 histogram merge
+  is integer-exact) must match tree-for-tree bit-identically modulo
+  XLA's sign-of-zero (``-0.0`` leaf values normalized — numerically
+  identical); the float cell must match the final eval metric within
+  FLOAT_TOL. The resumed event log must carry a ``reshard`` record.
 
 Cells cover fused/legacy drivers × serial/8-device mesh (both
 ``dp_hist_merge`` modes) with bagging + quantized gradients enabled —
 the RNG-stream-sensitive configs.
 
 Run: python scripts/chaos_train.py [--fast] [--cell NAME ...]
+     python scripts/chaos_train.py --elastic [--fast]
      python -m lightgbm_tpu chaos [--fast]
 Exit 0 when every assertion holds, 1 otherwise (the CI gate contract,
 alongside scripts/lint_traces.py).
@@ -90,6 +100,28 @@ CELLS = {
 KILLS_FULL = (2, 3, 5, 6, 9)
 KILLS_FAST = (3, 5)
 
+# -- elastic cells: kill at topology A, resume at topology B -----------
+_RS = {"tree_learner": "data", "dp_hist_merge": "reduce_scatter"}
+_AR = {"tree_learner": "data", "dp_hist_merge": "allreduce"}
+_SERIAL: dict = {}
+
+# name -> (params_A, ndev_A, params_B, ndev_B, base overrides)
+# matrix: {8->4, 8->1, 4->8 devices} x {serial<->data} x {ar<->rs}
+ELASTIC_CELLS = {
+    "elastic/8rs-4rs": (_RS, 8, _RS, 4, {}),
+    "elastic/8ar-serial1": (_AR, 8, _SERIAL, 1, {}),
+    "elastic/4rs-8ar": (_RS, 4, _AR, 8, {}),
+    "elastic/serial1-8rs": (_SERIAL, 1, _RS, 8, {}),
+    "elastic/8rs-serial8": (_RS, 8, _SERIAL, 8, {}),
+    # float histogram merge: not integer-exact across topology — the
+    # contract drops to eval-metric parity within FLOAT_TOL
+    "elastic/float-8ar-serial1": (_AR, 8, _SERIAL, 1,
+                                  {"use_quantized_grad": False}),
+}
+ELASTIC_FAST = ("elastic/8rs-4rs", "elastic/8ar-serial1")
+ELASTIC_KILL = 5        # mid-run, off both cadence boundaries
+FLOAT_TOL = 5e-3        # |auc_resumed - auc_baseline| bound, float cell
+
 _CHILD = '''
 import json, os, sys
 import numpy as np
@@ -125,10 +157,20 @@ except NumericDivergenceError as e:
     sys.exit(3)
 bst.save_model(params["output_model"])
 import hashlib
+import re
 sha = hashlib.sha256(
     open(params["output_model"], "rb").read()).hexdigest()
+# topology-invariant tree digest: the trees section only (the params
+# echo names the topology), without the tree_sizes= byte counts and
+# with -0.0 leaf values normalized -- XLA fusion decisions flip the
+# sign of zero between topologies, which is numerically identical
+trees = bst.model_to_string().split("parameters:")[0]
+trees = "\\n".join(ln for ln in trees.splitlines()
+                   if not ln.startswith("tree_sizes="))
+trees = re.sub(r"-0\\.0(?![0-9])", "0.0", trees)
 print("CHAOS=" + json.dumps({
     "model_sha": sha, "num_trees": bst.num_trees(),
+    "trees_sha": hashlib.sha256(trees.encode()).hexdigest(),
     "eval_hist": {k: {m: list(v) for m, v in d.items()}
                   for k, d in hist.items()}}))
 '''
@@ -149,17 +191,21 @@ class Chaos:
                 f.write(_CHILD)
         return self._child
 
-    def _env(self, cell, params, extra=None):
-        _, fused = CELLS[cell]
-        mesh = "mesh" in cell
-        return _probe.mesh_env(8 if mesh else 1, fused=fused, extra=dict(
+    def _env(self, cell, params, extra=None, ndev=None):
+        if cell in CELLS:
+            _, fused = CELLS[cell]
+            if ndev is None:
+                ndev = 8 if "mesh" in cell else 1
+        else:                       # elastic cells pin ndev explicitly
+            fused = True
+        return _probe.mesh_env(ndev, fused=fused, extra=dict(
             {"CHAOS_PARAMS": json.dumps(params),
              "CHAOS_ROUNDS": str(ROUNDS)}, **(extra or {})))
 
     def _run_child(self, cell, params, workdir, extra=None,
-                   timeout=600.0):
+                   timeout=600.0, ndev=None):
         """Run one training child; returns (payload|None, returncode)."""
-        env = self._env(cell, params, extra)
+        env = self._env(cell, params, extra, ndev=ndev)
         r = subprocess.run([sys.executable, self._child_path()],
                            cwd=workdir, env=env, capture_output=True,
                            text=True, timeout=timeout)
@@ -327,7 +373,79 @@ class Chaos:
             f"rc={rc2} iters={iters} vs base={base_iters} "
             f"headers={len(headers)} problems={problems[:3]}")
 
+    def elastic(self, name):
+        """Kill at topology A, resume at topology B; the resumed model
+        must match an uninterrupted all-B baseline (trees bit-identical
+        for quantized cells, final metric within FLOAT_TOL for float),
+        and the resumed event log must carry a ``reshard`` record."""
+        if _probe.REPO_ROOT not in sys.path:
+            sys.path.insert(0, _probe.REPO_ROOT)
+        from lightgbm_tpu.telemetry.events import read_events
+        pa, ndev_a, pb, ndev_b, base_over = ELASTIC_CELLS[name]
+        quantized = base_over.get("use_quantized_grad", True)
+        base = dict(_BASE, **base_over, output_model="m.txt",
+                    event_log="run.events.jsonl")
+        params_a, params_b = dict(base, **pa), dict(base, **pb)
+
+        d0 = os.path.join(self.root, name.replace("/", "_"), "base")
+        os.makedirs(d0, exist_ok=True)
+        payload, rc = self._run_child(name, params_b, d0, ndev=ndev_b)
+        if payload is None or "trees_sha" not in payload:
+            self.check(f"{name} baseline@B", False, f"rc={rc}")
+            return
+        self.check(f"{name} baseline@B", True)
+
+        d = os.path.join(self.root, name.replace("/", "_"), "kill")
+        os.makedirs(d, exist_ok=True)
+        _, rc_k = self._run_child(
+            name, params_a, d, ndev=ndev_a,
+            extra={"LIGHTGBM_TPU_CHAOS_KILL_ITER": str(ELASTIC_KILL),
+                   "LIGHTGBM_TPU_CHAOS_KILL_SIGNAL": "KILL"})
+        self.check(f"{name} kill@{ELASTIC_KILL}@A SIGKILL death",
+                   rc_k == -signal.SIGKILL, f"rc={rc_k}")
+        resumed, rc_r = self._run_child(name, params_b, d, ndev=ndev_b)
+        if resumed is None:
+            self.check(f"{name} resume@B", False, f"rc={rc_r}")
+            return
+        if quantized:
+            self.check(
+                f"{name} resume@B trees bit-identical + eval parity",
+                resumed.get("trees_sha") == payload["trees_sha"]
+                and resumed.get("eval_hist") == payload["eval_hist"],
+                f"trees {resumed.get('trees_sha')} "
+                f"vs {payload['trees_sha']}")
+        else:
+            h0 = payload["eval_hist"]["valid_0"]["auc"][-1]
+            h1 = resumed["eval_hist"]["valid_0"]["auc"][-1]
+            self.check(
+                f"{name} resume@B metric parity (|d|<{FLOAT_TOL})",
+                resumed.get("num_trees") == payload["num_trees"]
+                and abs(h1 - h0) < FLOAT_TOL,
+                f"auc {h1} vs {h0}")
+        recs = read_events(os.path.join(d, "run.events.jsonl"))
+        reshards = [r for r in recs if r.get("event") == "reshard"]
+        want = (pa, ndev_a) != (pb, ndev_b)
+        self.check(
+            f"{name} reshard event {'recorded' if want else 'absent'}",
+            bool(reshards) == want,
+            f"{len(reshards)} reshard records")
+
     # -- driver --------------------------------------------------------
+
+    def run_elastic(self, names):
+        try:
+            for name in names:
+                print(f"== {name} ==")
+                self.elastic(name)
+        finally:
+            shutil.rmtree(self.root, ignore_errors=True)
+        print(f"chaos_train: {self.passes} passed, "
+              f"{len(self.failures)} failed")
+        if self.failures:
+            for f in self.failures:
+                print(f"  FAILED: {f}", file=sys.stderr)
+            return 1
+        return 0
 
     def run_cell(self, cell, kills):
         print(f"== {cell} ==")
@@ -369,14 +487,23 @@ def main(argv=None) -> int:
                    help="one serial cell, two kill points (pre-push "
                         "smoke form)")
     p.add_argument("--cell", action="append", dest="cells",
-                   choices=sorted(CELLS),
+                   choices=sorted(CELLS) + sorted(ELASTIC_CELLS),
                    help="cell(s) to run; default: fast=fused/serial, "
                         "full=all")
     p.add_argument("--kills", default=None,
                    help="comma-separated kill iterations (overrides "
                         "the default sweep)")
+    p.add_argument("--elastic", action="store_true",
+                   help="run the topology-portable resume matrix "
+                        "(kill at topology A, resume at B) instead of "
+                        "the kill/corrupt/poison flows")
     ns = p.parse_args(argv)
+    if ns.elastic:
+        names = ([c for c in (ns.cells or []) if c in ELASTIC_CELLS]
+                 or list(ELASTIC_FAST if ns.fast else ELASTIC_CELLS))
+        return Chaos(fast=ns.fast).run_elastic(names)
     cells = ns.cells or (["fused/serial"] if ns.fast else list(CELLS))
+    cells = [c for c in cells if c in CELLS]
     kills = (tuple(int(k) for k in ns.kills.split(","))
              if ns.kills else None)
     return Chaos(fast=ns.fast).run(cells, kills=kills)

@@ -1,0 +1,341 @@
+"""Elastic-mesh resilience (ISSUE 12 tentpole): topology-portable
+checkpoints (model fingerprint vs topology descriptor, cross-topology
+resume), typed device-loss detection, the supervised degrade/retry
+loop, and the fail-open satellites (checkpoint-write failures must not
+kill a healthy run; a busy telemetry port must not either)."""
+
+import errno
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.resilience import (DeviceLossError, config_fingerprint,
+                                     read_checkpoint,
+                                     topology_descriptor)
+
+
+def _data(rng, n=800, f=10):
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2]
+         + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+# bagging + quantized gradients: the config whose cross-topology resume
+# is RNG-stream and device-state sensitive
+PARAMS = {"objective": "binary", "metric": "auc", "num_leaves": 7,
+          "learning_rate": 0.2, "min_data_in_leaf": 5, "verbosity": -1,
+          "bagging_fraction": 0.8, "bagging_freq": 2, "bagging_seed": 7,
+          "use_quantized_grad": True, "num_grad_quant_bins": 4,
+          "eval_period": 3, "snapshot_freq": 2, "snapshot_keep": 50,
+          "resume": "auto", "output_model": "m.txt"}
+
+_SERIAL = {"tree_learner": "serial"}
+_RS = {"tree_learner": "data", "dp_hist_merge": "reduce_scatter"}
+_AR = {"tree_learner": "data", "dp_hist_merge": "allreduce"}
+
+
+def _train(rng_seed, rounds=9, extra=None, n=800):
+    rng = np.random.RandomState(rng_seed)
+    X, y = _data(rng, n=n)
+    Xv, yv = _data(rng, n=max(200, n // 3))
+    ds = lgb.Dataset(X, label=y)
+    dv = lgb.Dataset(Xv, label=yv, reference=ds)
+    hist = {}
+    bst = lgb.train(dict(PARAMS, **(extra or {})), ds,
+                    num_boost_round=rounds, valid_sets=[dv],
+                    callbacks=[lgb.record_evaluation(hist)])
+    return bst, hist
+
+
+def _ckpts(d="."):
+    return sorted((f for f in os.listdir(d) if ".ckpt_iter_" in f),
+                  key=lambda f: int(f.rsplit("_", 1)[1]))
+
+
+def _trees(bst):
+    """Topology-invariant tree text: the trees section only, without
+    the tree_sizes= byte counts and with -0.0 leaf values normalized —
+    XLA fusion decisions flip the sign of zero between topologies,
+    which is numerically identical."""
+    txt = bst.model_to_string().split("parameters:")[0]
+    txt = "\n".join(ln for ln in txt.splitlines()
+                    if not ln.startswith("tree_sizes="))
+    return re.sub(r"-0\.0(?![0-9])", "0.0", txt)
+
+
+def _events(path="run.events.jsonl"):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+# ------------------------------------------------- fingerprint split
+def test_fingerprint_ignores_topology():
+    """Topology knobs decide WHERE the computation runs, not WHAT it
+    computes: they must not change the model fingerprint — while any
+    learning parameter must."""
+    base = dict(PARAMS, **_SERIAL)
+    fp = config_fingerprint(base)
+    for topo in (_RS, _AR, {"tree_learner": "data", "num_machines": 4},
+                 {"num_machines": 2, "local_listen_port": 12345}):
+        assert config_fingerprint(dict(PARAMS, **topo)) == fp, topo
+    assert config_fingerprint(dict(base, learning_rate=0.05)) != fp
+    assert config_fingerprint(dict(base, num_leaves=31)) != fp
+
+
+def test_topology_descriptor_recorded(rng, tmp_path, monkeypatch):
+    """Every checkpoint carries the writing process's topology
+    descriptor alongside the (topology-free) model fingerprint."""
+    monkeypatch.chdir(tmp_path)
+    _train(0, rounds=4, extra=_RS, n=400)
+    state, _, _ = read_checkpoint(_ckpts()[-1])
+    topo = state["topology"]
+    assert topo["tree_learner"] == "data"
+    assert topo["parallel_mode"] == "data"
+    assert topo["dp_hist_merge"] == "reduce_scatter"
+    assert topo["num_shards"] > 1
+    assert topo["num_devices"] == 8  # conftest pins the virtual mesh
+    assert state["config_fingerprint"] == config_fingerprint(
+        dict(PARAMS, **_RS))
+
+
+def test_topology_descriptor_live():
+    import jax
+    bst = lgb.train(dict(PARAMS, **_SERIAL, resume="off",
+                         snapshot_freq=0),
+                    lgb.Dataset(*_data(np.random.RandomState(0), 300)),
+                    2)
+    topo = topology_descriptor(bst._gbdt)
+    assert topo["tree_learner"] == "serial"
+    assert topo["num_shards"] == 1
+    assert topo["num_devices"] == int(jax.device_count())
+
+
+# --------------------------------------------- cross-topology resume
+@pytest.mark.slow
+@pytest.mark.parametrize("topo_a,topo_b", [
+    (_RS, _SERIAL),       # data-parallel -> serial (mesh shrink floor)
+    (_SERIAL, _RS),       # serial -> data-parallel (mesh grow)
+    (_AR, _RS),           # allreduce -> reduce_scatter plan flip
+], ids=["rs-serial", "serial-rs", "ar-rs"])
+def test_elastic_resume_bit_identical(rng, tmp_path, monkeypatch,
+                                      topo_a, topo_b):
+    """Delete the newest checkpoints of a finished topology-A run and
+    retrain the same command on topology B: the restore must re-shard
+    scores/bag-mask state onto B's plan and finish with the SAME trees
+    (quantized int32 histogram merge is integer-exact) and the SAME
+    eval history — and the event log must record the reshard."""
+    monkeypatch.chdir(tmp_path)
+    extra_log = {"event_log": "run.events.jsonl"}
+    bst1, hist1 = _train(0, extra=dict(topo_a, **extra_log))
+    trees1 = _trees(bst1)
+    # interrupt retroactively: drop everything newer than iteration 4
+    for f in _ckpts():
+        if int(f.rsplit("_", 1)[1]) > 4:
+            os.unlink(f)
+    bst2, hist2 = _train(0, extra=dict(topo_b, **extra_log))
+    assert _trees(bst2) == trees1
+    assert hist2 == hist1
+    reshards = [r for r in _events() if r["event"] == "reshard"]
+    assert reshards, "no reshard event recorded"
+    assert reshards[-1]["from"]["tree_learner"] == \
+        topo_a["tree_learner"]
+    assert reshards[-1]["to"]["tree_learner"] == topo_b["tree_learner"]
+
+
+def test_same_topology_resume_emits_no_reshard(rng, tmp_path,
+                                               monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    extra = dict(_SERIAL, event_log="run.events.jsonl")
+    _train(0, rounds=6, extra=extra, n=400)
+    for f in _ckpts():
+        if int(f.rsplit("_", 1)[1]) > 4:
+            os.unlink(f)
+    _train(0, rounds=6, extra=extra, n=400)
+    assert [r for r in _events() if r["event"] == "resume"]
+    assert not [r for r in _events() if r["event"] == "reshard"]
+
+
+def test_resume_rejects_different_dataset(rng, tmp_path, monkeypatch):
+    """Topology left the fingerprint, so the dataset shape recorded in
+    the checkpoint is now the guard against resuming someone else's
+    run: a different num_data must refuse to restore."""
+    monkeypatch.chdir(tmp_path)
+    _train(0, rounds=4, extra=_SERIAL, n=400)
+    with pytest.raises(ValueError, match="different dataset"):
+        _train(0, rounds=4, extra=_SERIAL, n=500)
+
+
+# -------------------------------------------------- device loss: typed
+def test_device_loss_error_typed(rng, tmp_path, monkeypatch):
+    """An XLA runtime failure escaping a boosting step surfaces as
+    DeviceLossError carrying the iteration, not a bare RuntimeError."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("LIGHTGBM_TPU_CHAOS_DEVLOSS_ITER", "4")
+    with pytest.raises(DeviceLossError) as ei:
+        _train(0, extra=_SERIAL, n=400)
+    assert ei.value.iteration == 4
+    assert "device loss" in str(ei.value)
+    assert isinstance(ei.value, RuntimeError)
+
+
+# ------------------------------------------------ supervised degrade
+def test_supervised_degrade_transient_retry(rng, tmp_path, monkeypatch):
+    """A transient device loss under on_device_loss=degrade restores
+    the newest checkpoint, retries, completes — with trees identical to
+    an undisturbed run — and records the attempt in the event log."""
+    monkeypatch.chdir(tmp_path)
+    extra = dict(_SERIAL, event_log="run.events.jsonl")
+    bst1, hist1 = _train(0, extra=extra, n=400)
+    trees1 = _trees(bst1)
+    for f in _ckpts() + ["run.events.jsonl"]:
+        os.unlink(f)
+    monkeypatch.setenv("LIGHTGBM_TPU_CHAOS_DEVLOSS_ITER", "4")
+    monkeypatch.setenv("LIGHTGBM_TPU_CHAOS_DEVLOSS_ONCE",
+                       str(tmp_path / "devloss.marker"))
+    bst2, hist2 = _train(0, extra=dict(extra, on_device_loss="degrade"),
+                         n=400)
+    assert os.path.exists(str(tmp_path / "devloss.marker"))  # it fired
+    assert bst2.current_iteration() == 9
+    assert _trees(bst2) == trees1
+    assert hist2 == hist1
+    degraded = [r for r in _events() if r["event"] == "degraded"]
+    assert [(r["attempt"], r["action"]) for r in degraded] == \
+        [(1, "retry")]
+
+
+@pytest.mark.slow
+def test_supervised_shrink_to_serial(rng, tmp_path, monkeypatch):
+    """A device loss that persists on the data-parallel plan (chaos
+    mode=mesh: fires only while a mesh plan is active) degrades to
+    tree_learner=serial on the second attempt and completes — the
+    elastic-restore path re-shards the checkpoint state down to the
+    serial floor mid-process."""
+    monkeypatch.chdir(tmp_path)
+    extra_log = {"event_log": "run.events.jsonl"}
+    bst1, _ = _train(0, extra=dict(_SERIAL, **extra_log), n=400)
+    trees1 = _trees(bst1)
+    for f in _ckpts() + ["run.events.jsonl"]:
+        os.unlink(f)
+    monkeypatch.setenv("LIGHTGBM_TPU_CHAOS_DEVLOSS_ITER", "4")
+    monkeypatch.setenv("LIGHTGBM_TPU_CHAOS_DEVLOSS_MODE", "mesh")
+    bst2, _ = _train(0, extra=dict(_RS, **extra_log,
+                                   on_device_loss="degrade"), n=400)
+    assert bst2.current_iteration() == 9
+    assert _trees(bst2) == trees1
+    degraded = [(r["attempt"], r["action"]) for r in _events()
+                if r["event"] == "degraded"]
+    assert degraded == [(1, "retry"), (2, "shrink_to_serial")]
+    assert [r for r in _events() if r["event"] == "reshard"]
+
+
+def test_supervised_gives_up_after_retries(rng, tmp_path, monkeypatch):
+    """A loss that persists past max_retries re-raises DeviceLossError
+    and records the give-up — never an infinite loop."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("LIGHTGBM_TPU_CHAOS_DEVLOSS_ITER", "4")
+    with pytest.raises(DeviceLossError):
+        _train(0, extra=dict(_SERIAL, event_log="run.events.jsonl",
+                             on_device_loss="degrade"), n=400)
+    actions = [r["action"] for r in _events()
+               if r["event"] == "degraded"]
+    assert actions[-1] == "give_up"
+    assert len(actions) == 4  # 3 retries + the give-up record
+
+
+def test_supervised_backoff_is_exponential(monkeypatch):
+    """Unit-level: the supervisor sleeps backoff_base * 2^(attempt-1)
+    between retries (no training needed — train_fn is stubbed)."""
+    from lightgbm_tpu.resilience.supervisor import supervised_train
+    calls = []
+    sleeps = []
+
+    def fake_train(params, train_set, num_boost_round, **kw):
+        calls.append(dict(params))
+        if len(calls) < 3:
+            raise DeviceLossError(5, "injected")
+        return "booster"
+
+    out = supervised_train(fake_train, {"output_model": "m.txt",
+                                        "resume": "auto"},
+                           train_set=None, num_boost_round=9,
+                           backoff_base_s=0.25, sleep=sleeps.append)
+    assert out == "booster"
+    assert sleeps == [0.25, 0.5]
+    # the child must not recurse into the supervisor
+    assert all(p["on_device_loss"] == "fail" for p in calls)
+
+
+# ------------------------------------- checkpoint-write fail-open
+def _flaky_writer(fail_times):
+    """atomic_write_bytes stand-in failing the first N calls with
+    ENOSPC."""
+    from lightgbm_tpu.resilience.atomic_io import atomic_write_bytes
+    n = {"left": fail_times, "failed": 0}
+
+    def write(path, blob):
+        if n["left"] > 0:
+            n["left"] -= 1
+            n["failed"] += 1
+            raise OSError(errno.ENOSPC, "No space left on device")
+        return atomic_write_bytes(path, blob)
+
+    return write, n
+
+
+def test_checkpoint_write_failure_does_not_kill_run(rng, tmp_path,
+                                                    monkeypatch):
+    """Transient ENOSPC on a snapshot boundary: warn, record a failed
+    checkpoint event, keep training, and write again at a later
+    boundary once space returns."""
+    monkeypatch.chdir(tmp_path)
+    write, n = _flaky_writer(2)
+    monkeypatch.setattr("lightgbm_tpu.resilience.checkpoint."
+                        "atomic_write_bytes", write)
+    bst, _ = _train(0, extra=dict(_SERIAL,
+                                  event_log="run.events.jsonl"), n=400)
+    assert bst.current_iteration() == 9          # run survived
+    assert n["failed"] == 2                      # fault actually fired
+    assert _ckpts()                              # later boundary wrote
+    failed = [r for r in _events() if r["event"] == "checkpoint"
+              and r.get("ok") is False]
+    assert failed and failed[0]["action"] == "write"
+
+
+def test_checkpoint_write_failure_persistent_raises(rng, tmp_path,
+                                                    monkeypatch):
+    """A disk that never comes back is fatal after the bounded streak —
+    silently training forever with no checkpoints is not a mode."""
+    monkeypatch.chdir(tmp_path)
+    write, _ = _flaky_writer(10 ** 6)
+    monkeypatch.setattr("lightgbm_tpu.resilience.checkpoint."
+                        "atomic_write_bytes", write)
+    with pytest.raises(OSError):
+        _train(0, extra=_SERIAL, n=400)
+
+
+# ----------------------------------------- telemetry port fail-open
+def test_telemetry_port_conflict_fails_open(rng, tmp_path, monkeypatch):
+    """A busy telemetry_port must not kill training: warn, run without
+    the live exporter, finish normally."""
+    import socket
+    monkeypatch.chdir(tmp_path)
+    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+    port = sock.getsockname()[1]
+    try:
+        bst, _ = _train(0, rounds=4,
+                        extra=dict(_SERIAL, telemetry_port=port,
+                                   event_log="run.events.jsonl"),
+                        n=400)
+    finally:
+        sock.close()
+    assert bst.current_iteration() == 4
+    warns = [r for r in _events() if r["event"] == "log"
+             and "cannot bind exporter port" in str(r.get("msg"))]
+    assert warns

@@ -360,6 +360,73 @@ def test_preemption_writes_checkpoint_and_resumes(rng, tmp_path,
     assert hist2 == hist1
 
 
+# ------------------------------------------- resume-scan edge cases
+def _fake_ckpt(path, iteration, fingerprint):
+    write_checkpoint(str(path),
+                     {"iteration": iteration,
+                      "config_fingerprint": fingerprint},
+                     {"x": np.ones(8)}, {"m": "t"})
+
+
+def test_scan_skips_unreadable_file(tmp_path):
+    """A checkpoint the scanner cannot OPEN (permission error, or a
+    directory squatting on the name) is skipped like corruption — the
+    scan falls back to the next older valid one."""
+    from lightgbm_tpu.resilience import find_resume_checkpoint
+    out = str(tmp_path / "m.txt")
+    _fake_ckpt(out + ".ckpt_iter_4", 4, "FP")
+    # a directory with a checkpoint name: open('rb') raises OSError
+    os.mkdir(out + ".ckpt_iter_9")
+    assert find_resume_checkpoint(out, "FP") == out + ".ckpt_iter_4"
+    if os.geteuid() != 0:        # root ignores mode bits
+        _fake_ckpt(out + ".ckpt_iter_7", 7, "FP")
+        os.chmod(out + ".ckpt_iter_7", 0o000)
+        try:
+            assert find_resume_checkpoint(out, "FP") == \
+                out + ".ckpt_iter_4"
+        finally:
+            os.chmod(out + ".ckpt_iter_7", 0o644)
+
+
+def test_scan_survives_prune_race(tmp_path, monkeypatch):
+    """snapshot_keep pruning in another process can delete the newest
+    checkpoint between the scanner's listing and its read: the ENOENT
+    must read as a skip, not a crash."""
+    import lightgbm_tpu.resilience.checkpoint as ckpt_mod
+    out = str(tmp_path / "m.txt")
+    _fake_ckpt(out + ".ckpt_iter_4", 4, "FP")
+    _fake_ckpt(out + ".ckpt_iter_8", 8, "FP")
+    real_read = ckpt_mod.read_checkpoint
+    raced = {"done": False}
+
+    def racing_read(path):
+        if not raced["done"]:
+            raced["done"] = True
+            os.unlink(path)          # the concurrent pruner wins
+        return real_read(path)
+
+    monkeypatch.setattr(ckpt_mod, "read_checkpoint", racing_read)
+    assert ckpt_mod.find_resume_checkpoint(out, "FP") == \
+        out + ".ckpt_iter_4"
+    assert raced["done"]
+
+
+def test_scan_mixed_fingerprint_families(tmp_path):
+    """A directory holding checkpoints from several configs (topology
+    left the fingerprint, so this is now common): the scanner must
+    return the newest checkpoint of the MATCHING family, not the
+    newest file."""
+    from lightgbm_tpu.resilience import find_resume_checkpoint
+    out = str(tmp_path / "m.txt")
+    _fake_ckpt(out + ".ckpt_iter_2", 2, "MINE")
+    _fake_ckpt(out + ".ckpt_iter_5", 5, "MINE")
+    _fake_ckpt(out + ".ckpt_iter_9", 9, "THEIRS")
+    assert find_resume_checkpoint(out, "MINE") == out + ".ckpt_iter_5"
+    assert find_resume_checkpoint(out, "THEIRS") == \
+        out + ".ckpt_iter_9"
+    assert find_resume_checkpoint(out, "NOBODY") is None
+
+
 # ------------------------------------------------------------- harness
 def test_chaos_cli_wiring(capsys):
     """`python -m lightgbm_tpu chaos --help` loads the harness by path

@@ -458,3 +458,104 @@ def test_cli_serve_requires_model():
     from lightgbm_tpu import cli
     with pytest.raises(SystemExit, match="model"):
         cli.run({"task": "serve"})
+
+
+# ----------------------------------------------------- graceful drain
+def test_healthz_alive_ready_split(served):
+    """Liveness vs readiness: /healthz/alive answers 200 whenever the
+    process serves HTTP; /healthz (and its /ready alias) flips to 503
+    the moment the server starts draining."""
+    X, bst, srv, base, _ = served
+    alive = json.loads(urllib.request.urlopen(
+        base + "/healthz/alive", timeout=10).read())
+    assert alive == {"status": "alive"}
+    ready = json.loads(urllib.request.urlopen(
+        base + "/healthz/ready", timeout=10).read())
+    assert ready["status"] == "ok"
+
+    srv.draining = True          # draining: alive stays up, ready drops
+    alive = json.loads(urllib.request.urlopen(
+        base + "/healthz/alive", timeout=10).read())
+    assert alive == {"status": "alive"}
+    for path in ("/healthz", "/healthz/ready"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(base + path, timeout=10)
+        assert e.value.code == 503
+        assert json.loads(e.value.read())["status"] == "draining"
+    srv.draining = False
+
+
+def test_drain_finishes_inflight_work(served):
+    """drain() must answer requests already accepted into the batcher
+    before returning — and stop() must be idempotent afterwards."""
+    X, bst, srv, base, _ = served
+    Xq = np.ascontiguousarray(X[:8], np.float64)
+    expect = bst.predict_session().predict(Xq)
+    real = srv.registry.predict
+    gate = threading.Event()
+
+    def slow_predict(Z, name=None):
+        gate.wait(10)
+        return real(Z, name)
+
+    srv.registry.predict = slow_predict
+    results = []
+    t = threading.Thread(
+        target=lambda: results.append(srv.predict(Xq)[0]))
+    t.start()
+    time.sleep(0.2)              # request is queued behind the gate
+    dt = threading.Thread(target=srv.drain)
+    dt.start()
+    time.sleep(0.2)
+    gate.set()                   # storage recovers; drain completes
+    dt.join(timeout=15)
+    t.join(timeout=15)
+    assert not dt.is_alive() and not t.is_alive()
+    assert srv.draining
+    np.testing.assert_array_equal(results[0], expect)
+    srv.stop()                   # second stop: clean no-op
+
+
+@pytest.mark.slow
+def test_serve_sigterm_drains_and_exits(rng, tmp_path):
+    """python -m lightgbm_tpu serve: SIGTERM flips readiness, finishes
+    in-flight work, and exits 0 — the rolling-restart contract."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    X, bst = _model(rng)
+    mpath = tmp_path / "m.txt"
+    bst.save_model(str(mpath))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "serve",
+         f"model={mpath}", "port=0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    try:
+        base = None
+        for ln in proc.stdout:
+            if "serving on " in ln:
+                base = ln.split("serving on ", 1)[1].split(" ")[0]
+                break
+        assert base, "server never announced its port"
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                r = json.loads(urllib.request.urlopen(
+                    base + "/healthz/ready", timeout=5).read())
+                if r.get("status") == "ok":
+                    break
+            except (urllib.error.URLError, ConnectionError):
+                time.sleep(0.2)
+        proc.send_signal(signal.SIGTERM)
+        out = proc.stdout.read()
+        rc = proc.wait(timeout=30)
+        assert rc == 0, f"rc={rc} out={out[-1000:]}"
+        assert "draining" in out
+        assert "drained: in-flight work finished" in out
+    finally:
+        if proc.poll() is None:
+            proc.kill()

@@ -6,16 +6,14 @@ CLI."""
 
 import http.client
 import json
-import os
 import re
-import threading
 
 import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
 from lightgbm_tpu import log
-from lightgbm_tpu.telemetry import TelemetrySession, active_session
+from lightgbm_tpu.telemetry import active_session
 from lightgbm_tpu.telemetry.core import (Counter, Gauge, MetricsRegistry,
                                          RingHistogram)
 from lightgbm_tpu.telemetry.events import (EventLog, check_records,
