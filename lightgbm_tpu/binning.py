@@ -123,16 +123,36 @@ class BinMapper:
                     use_missing: bool = True, zero_as_missing: bool = False,
                     total_cnt: Optional[int] = None,
                     forced_bounds: Optional[list] = None) -> "BinMapper":
-        m = cls()
-        m.bin_type = bin_type
         values = np.asarray(values, dtype=np.float64)
-        if total_cnt is None:
-            total_cnt = len(values)
         nan_mask = np.isnan(values)
         n_nan = int(nan_mask.sum())
-        non_nan = values[~nan_mask]
+        dv, cnts = _distinct(values[~nan_mask])
+        return cls.from_distinct(
+            dv, cnts, n_nan, max_bin=max_bin,
+            min_data_in_bin=min_data_in_bin, bin_type=bin_type,
+            use_missing=use_missing, zero_as_missing=zero_as_missing,
+            forced_bounds=forced_bounds)
+
+    @classmethod
+    def from_distinct(cls, distinct_values: np.ndarray, counts: np.ndarray,
+                      n_nan: int = 0, max_bin: int = 255,
+                      min_data_in_bin: int = 3, bin_type: str = "numerical",
+                      use_missing: bool = True, zero_as_missing: bool = False,
+                      forced_bounds: Optional[list] = None) -> "BinMapper":
+        """Fit from a (sorted-distinct non-NaN values, counts, n_nan)
+        multiset summary — bit-identical to :meth:`from_values` on the
+        same multiset. This is the entry point the out-of-core quantile
+        sketch uses (``data/sketch.py``): the whole greedy pipeline only
+        ever consumes distinct values with multiplicities, so a merged
+        sketch that preserves the exact multiset reproduces the
+        in-memory mapper exactly."""
+        m = cls()
+        m.bin_type = bin_type
+        dv = np.asarray(distinct_values, dtype=np.float64)
+        cnts = np.asarray(counts, dtype=np.int64)
         if bin_type == "categorical":
-            m._construct_categorical(non_nan, max_bin, min_data_in_bin)
+            m._construct_categorical_distinct(dv, cnts, max_bin,
+                                              min_data_in_bin)
             return m
 
         if zero_as_missing and use_missing:
@@ -143,8 +163,7 @@ class BinMapper:
             m.missing_type = MISSING_NONE
             # without use_missing, NaN is treated as zero (bin.cpp semantics)
 
-        zero_mask = np.abs(non_nan) <= kZeroThreshold
-        n_zero = int(zero_mask.sum())
+        n_zero = int(cnts[np.abs(dv) <= kZeroThreshold].sum())
         if m.missing_type == MISSING_ZERO:
             n_zero += n_nan
 
@@ -154,9 +173,10 @@ class BinMapper:
 
         if n_zero > 0 or m.missing_type == MISSING_ZERO:
             # dedicated zero bin: greedy left of -eps, [-eps, eps], right
-            neg = non_nan[non_nan < -kZeroThreshold]
-            pos = non_nan[non_nan > kZeroThreshold]
-            n_neg, n_pos = len(neg), len(pos)
+            neg_sel = dv < -kZeroThreshold
+            pos_sel = dv > kZeroThreshold
+            n_neg = int(cnts[neg_sel].sum())
+            n_pos = int(cnts[pos_sel].sum())
             budget = max(1, effective_max_bin - 1)
             if n_neg + n_pos > 0:
                 left_max = int(round(budget * n_neg / (n_neg + n_pos)))
@@ -166,8 +186,8 @@ class BinMapper:
                 left_max, right_max = 0, 0
             bounds: List[float] = []
             if n_neg:
-                dv, cnts = _distinct(neg)
-                b = _greedy_find_bin(dv, cnts, max(1, left_max), n_neg,
+                b = _greedy_find_bin(dv[neg_sel], cnts[neg_sel],
+                                     max(1, left_max), n_neg,
                                      min_data_in_bin)
                 b[-1] = -kZeroThreshold
                 bounds.extend(b)
@@ -175,17 +195,16 @@ class BinMapper:
                 bounds.append(-kZeroThreshold)
             bounds.append(kZeroThreshold)  # zero bin upper bound
             if n_pos:
-                dv, cnts = _distinct(pos)
-                bounds.extend(_greedy_find_bin(dv, cnts, max(1, right_max),
+                bounds.extend(_greedy_find_bin(dv[pos_sel], cnts[pos_sel],
+                                               max(1, right_max),
                                                n_pos, min_data_in_bin))
             else:
                 bounds.append(np.inf)
             if bounds[-1] != np.inf:
                 bounds.append(np.inf)
         else:
-            dv, cnts = _distinct(non_nan)
             bounds = _greedy_find_bin(dv, cnts, effective_max_bin,
-                                      len(non_nan), min_data_in_bin)
+                                      int(cnts.sum()), min_data_in_bin)
         ub = np.asarray(bounds, dtype=np.float64)
         if forced_bounds:
             # forcedbins_filename (dataset_loader.cpp GetForcedBins):
@@ -199,21 +218,41 @@ class BinMapper:
         m.bin_upper_bound = ub
         m.num_bin = len(ub) + (1 if m.missing_type == MISSING_NAN else 0)
         m.default_bin = int(np.searchsorted(ub, 0.0, side="left"))
-        # most_freq_bin from the sample
-        sample_bins = m.values_to_bins(values)
-        if len(sample_bins):
-            m.most_freq_bin = int(np.bincount(
-                sample_bins, minlength=m.num_bin).argmax())
+        # most_freq_bin: counts-weighted histogram of the distinct values'
+        # bins, NaN rows landing on the NaN/default bin exactly as
+        # values_to_bins sends them (counts are exact in f64 up to 2^53)
+        if int(cnts.sum()) + n_nan > 0:
+            bc = np.bincount(m.values_to_bins(dv),
+                             weights=cnts.astype(np.float64),
+                             minlength=m.num_bin)
+            nb = (m.num_bin - 1 if m.missing_type == MISSING_NAN
+                  else m.default_bin)
+            bc[nb] += n_nan
+            m.most_freq_bin = int(bc.argmax())
         m.is_trivial = (len(ub) <= 1 and m.missing_type != MISSING_NAN) or \
             m.num_bin <= 1
         return m
 
     def _construct_categorical(self, values: np.ndarray, max_bin: int,
                                min_data_in_bin: int):
+        dv, cnts = _distinct(values)
+        self._construct_categorical_distinct(dv, cnts, max_bin,
+                                             min_data_in_bin)
+
+    def _construct_categorical_distinct(self, dv: np.ndarray,
+                                        cnts: np.ndarray, max_bin: int,
+                                        min_data_in_bin: int):
         # negative categorical values are treated as missing (reference
         # warns and maps them out); categories sorted by count desc.
-        vals = values[values >= 0].astype(np.int64)
-        cats, counts = np.unique(vals, return_counts=True)
+        sel = dv >= 0
+        ivals = dv[sel].astype(np.int64)
+        icnts = cnts[sel]
+        # distinct floats can collapse onto one integer category — sum
+        # their multiplicities (unique returns ascending categories, so
+        # the stable count-desc sort ties out exactly like from_values)
+        cats, inverse = np.unique(ivals, return_inverse=True)
+        counts = np.zeros(len(cats), np.int64)
+        np.add.at(counts, inverse, icnts)
         order = np.argsort(-counts, kind="stable")
         cats, counts = cats[order], counts[order]
         # cut rare categories: keep while count > 0 and within max_bin

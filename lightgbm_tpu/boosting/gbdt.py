@@ -89,6 +89,23 @@ class _DeviceData:
             self.row_leaf0 = jnp.asarray(row_leaf0)
 
 
+class _ChunkedDeviceData:
+    """Device-data stand-in for the out-of-core chunked driver: the
+    row bookkeeping of :class:`_DeviceData` without a resident matrix
+    (``bins`` stays None — the prefetcher streams it). Geometry follows
+    the prefetcher's chunk lattice so the [R]-shaped score/gradient
+    arrays line up with the streamed chunks."""
+
+    def __init__(self, ds: Dataset, prefetcher):
+        self.num_data = ds.num_data
+        self.r_pad = int(prefetcher.padded_rows)
+        self.r_local = self.r_pad
+        self.bins = None
+        self.row_leaf0 = jnp.asarray(
+            np.where(np.arange(self.r_pad) < ds.num_data, 0, -1)
+            .astype(np.int32))
+
+
 class GBDT:
     # subclasses that replay past trees (DART) keep them on device;
     # plain gbdt/rf retain only the host Tree models
@@ -290,8 +307,29 @@ class GBDT:
             _lattice = -(-(F * self.B) // self.plan.num_shards)
         self._hist_sub = _hist_sub_gate(-(-_lattice // n_fs))
         # capacity gate BEFORE the device transfer (VERDICT r4 #5):
-        # fail with sized guidance, not a mid-training device OOM
+        # fail with sized guidance, not a mid-training device OOM — or,
+        # when the chunked out-of-core driver can take the run, degrade
+        # to streaming row chunks instead of failing (PR 13)
         from ..dataset import check_device_capacity
+        self.chunked = False
+        self._chunk_source = None
+        self._prefetcher = None
+        self._chunked_builder = None       # built at the end of __init__
+        oc = str(getattr(config, "out_of_core", "auto"))
+        chunk_reason = self._chunked_gate_reason()
+        shard_src = getattr(self.train_set, "chunk_source", None)
+        if oc == "on" or (oc == "auto" and shard_src is not None):
+            if chunk_reason:
+                if oc == "on":
+                    raise ValueError(
+                        "out_of_core=on but chunked training cannot "
+                        f"drive this run: {chunk_reason}")
+                # shard-backed dataset with a feature the chunked
+                # builder gates out: fall through to the resident path
+                # (Dataset.bins materializes the matrix lazily)
+            else:
+                self.chunked = True
+                self._chunk_source = shard_src
         # multi-process: num_data is this process's LOCAL rows and they
         # spread over the process's own devices only — dividing by the
         # GLOBAL device count would understate the per-chip footprint
@@ -300,23 +338,55 @@ class GBDT:
                                // getattr(self.plan, "num_processes", 1))
         else:
             n_row_shards = 1
-        if self._unbundle_feature:
-            # the device holds the UNBUNDLED matrix: per-feature width
-            # and the (possibly narrower) per-feature dtype
-            cap_width = F
-            cap_itemsize = 1 if self.B <= 256 else 4  # unbundled_bins dtype
+        if not self.chunked:
+            if self._unbundle_feature:
+                # the device holds the UNBUNDLED matrix: per-feature
+                # width and the (possibly narrower) per-feature dtype
+                cap_width = F
+                cap_itemsize = 1 if self.B <= 256 else 4  # unbundled dtype
+            else:
+                cap_width = self.train_set.bins.shape[1]
+                cap_itemsize = self.train_set.bins.dtype.itemsize
+            # feature_shard_storage: each device stores only its own
+            # column slice of the (padded) matrix
+            cap_width = -(-cap_width // n_fs)
+            try:
+                check_device_capacity(
+                    self.train_set.num_data, cap_width, cap_itemsize,
+                    config.num_leaves, self._bundle_bins or self.B,
+                    self._hist_sub, n_row_shards=n_row_shards)
+            except MemoryError:
+                if oc == "off" or chunk_reason:
+                    raise
+                # the resident matrix does not fit but the run is
+                # chunkable: degrade transparently (shard-backed data
+                # keeps its mmap stream; in-memory data streams the
+                # host matrix)
+                from .. import log as _log
+                _log.warning(
+                    "binned matrix exceeds device capacity; streaming "
+                    "it in row chunks (out_of_core) instead")
+                self.chunked = True
+                self._chunk_source = shard_src
+        if self.chunked:
+            from ..data.chunked import ArraySource
+            from ..data.prefetch import ChunkPrefetcher, chunk_rows_for
+            if self._chunk_source is None:
+                self._chunk_source = ArraySource(
+                    np.asarray(self.train_set.bins))
+            itemsize = int(
+                self._chunk_source.read_rows(0, 1).dtype.itemsize)
+            c_rows = chunk_rows_for(
+                self.train_set.num_data,
+                self._chunk_source.num_features, itemsize,
+                config.chunk_budget_mb, self.block)
+            self._prefetcher = ChunkPrefetcher(self._chunk_source, c_rows)
+            self.train_dd = _ChunkedDeviceData(self.train_set,
+                                               self._prefetcher)
         else:
-            cap_width = self.train_set.bins.shape[1]
-            cap_itemsize = self.train_set.bins.dtype.itemsize
-        # feature_shard_storage: each device stores only its own column
-        # slice of the (padded) matrix
-        cap_width = -(-cap_width // n_fs)
-        check_device_capacity(
-            self.train_set.num_data, cap_width, cap_itemsize,
-            config.num_leaves, self._bundle_bins or self.B,
-            self._hist_sub, n_row_shards=n_row_shards)
-        self.train_dd = _DeviceData(self.train_set, self.block, self.plan,
-                                    unbundle=self._unbundle_feature)
+            self.train_dd = _DeviceData(self.train_set, self.block,
+                                        self.plan,
+                                        unbundle=self._unbundle_feature)
         self._bins_cm = None            # lazy column-major copy (native)
         self.valid_dd = [
             _DeviceData(v.construct(), self.block, self.plan,
@@ -638,6 +708,26 @@ class GBDT:
         self.fused_reason = self._fused_gate_reason()
         self.fused_ok = not self.fused_reason
 
+        if self.chunked:
+            # built HERE (not at the capacity gate) because it consumes
+            # the per-feature metadata and split params assembled above;
+            # one builder per booster — its four jitted round programs
+            # cache their compilations across trees and iterations
+            from ..data.chunked import ChunkedTreeBuilder
+            self._chunked_builder = ChunkedTreeBuilder(
+                num_bins_pf=self.num_bins_pf,
+                nan_bin_pf=self.nan_bin_pf,
+                is_cat_pf=self.is_cat_pf,
+                num_leaves=config.num_leaves,
+                leaf_batch=config.leaf_batch,
+                max_depth=config.max_depth,
+                num_bins=self.B,
+                split_params=self.split_params,
+                hist_dtype=config.hist_dtype,
+                hist_impl=config.hist_impl,
+                block_rows=self.block,
+                cat_sorted_mask=self._cat_sorted_mask)
+
     # ------------------------------------------------------------------
     def _field_init_scores(self, init, n: int, r_pad: int) -> np.ndarray:
         """Metadata init_score -> [K, r_pad] f32.
@@ -909,6 +999,20 @@ class GBDT:
         cfg = self.config
         if it is None:
             it = self.iter_
+        if self.chunked:
+            # out-of-core: stream the bin matrix through the chunked
+            # builder. Its gate already pinned every feature the kw
+            # plumbing below would add (quant/gain_scale ride through).
+            kwc = {}
+            if quant_scales is not None:
+                kwc["quant_scales"] = quant_scales
+            if self._gain_scale is not None:
+                kwc["gain_scale"] = self._gain_scale
+            return self._chunked_builder.build(
+                self._prefetcher, gh, self.train_dd.row_leaf0, fmask,
+                valid_bins=tuple(dd.bins for dd in self.valid_dd),
+                valid_row_leaf0=tuple(dd.row_leaf0
+                                      for dd in self.valid_dd), **kwc)
         builder = (self.plan.build_tree if self.plan is not None
                    else functools.partial(build_tree, traced=traced))
         # fold both iteration and class index: multiclass trees of one
@@ -974,6 +1078,41 @@ class GBDT:
             return tree_arrays, row_leaf, valid_rls
         return out
 
+    # -- out-of-core chunked training gate (ISSUE 13) ------------------
+
+    def _chunked_gate_reason(self) -> str:
+        """Why the out-of-core chunked driver cannot grow this run's
+        trees ('' = it can). The chunked builder replays the serial
+        builder's simple round body over streamed row chunks; anything
+        that bends that body — whole-matrix device state, per-node host
+        coordination, cross-leaf bound propagation — pins the resident
+        path. Evaluated at the capacity gate, so it reads raw config
+        (``_cegb``/``_forced_splits`` are assembled later)."""
+        cfg = self.config
+        if type(self) is not GBDT:
+            return "boosting mode replays resident device trees"
+        if self.plan is not None:
+            return "parallel plans place the full device matrix"
+        if self._bundle_meta is not None:
+            return "EFB bundles bin in device bundle space"
+        if bool(cfg.linear_tree):
+            return "linear leaves read resident raw feature values"
+        if cfg.monotone_constraints:
+            return "monotone constraints propagate cross-leaf bounds"
+        if cfg.interaction_constraints:
+            return "interaction constraints thread per-node ancestry"
+        if cfg.forcedsplits_filename:
+            return "forced splits assign node slots sequentially"
+        if (cfg.cegb_tradeoff < 1.0 or cfg.cegb_penalty_split > 0.0
+                or cfg.cegb_penalty_feature_coupled
+                or cfg.cegb_penalty_feature_lazy):
+            return "CEGB tracks per-row feature-use device state"
+        if float(cfg.feature_fraction_bynode) < 1.0:
+            return "per-node feature sampling draws inside the builder"
+        if bool(cfg.extra_trees):
+            return "extra-trees thresholds draw inside the builder"
+        return ""
+
     # -- class-batched multiclass build (ISSUE 8) ----------------------
 
     def _class_batch_reason(self) -> str:
@@ -990,6 +1129,8 @@ class GBDT:
         env = os.environ.get("LIGHTGBM_TPU_CLASS_BATCH", "")
         if env == "0":
             return "LIGHTGBM_TPU_CLASS_BATCH=0"
+        if self.chunked:
+            return "out-of-core training streams row chunks per tree"
         mode = "on" if env == "1" else str(cfg.class_batch)
         if mode == "off":
             return "class_batch=off"
@@ -1326,6 +1467,8 @@ class GBDT:
             return "LIGHTGBM_TPU_FUSED_TRAIN=0"
         if not bool(cfg.fused_train):
             return "fused_train=false"
+        if self.chunked:
+            return "out-of-core chunk sweeps are host-driven"
         if type(self) is not GBDT:
             return "boosting mode overrides the iteration loop"
         if self.objective is None:
@@ -1856,6 +1999,11 @@ class GBDT:
         self.sync()        # deferred trees must exist before undoing one
         if self.iter_ <= 0:
             return
+        if self.chunked:
+            raise NotImplementedError(
+                "rollback_one_iter replays trees over the resident "
+                "binned matrix, which out-of-core chunked training "
+                "never materializes")
         uf = self.train_set.used_features
         nan_bins = np.asarray(self.nan_bin_pf)
         bins_h = self._host_feature_bins(np.asarray(self.train_dd.bins))

@@ -255,6 +255,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
               "       python -m lightgbm_tpu serve model=<file> "
               "[port=8080 ...]\n"
+              "       python -m lightgbm_tpu ingest data=<csv|npy|npz> "
+              "out=<dir> [key=value ...]\n"
               "       python -m lightgbm_tpu trace-doctor [--config ...]"
               " [--mode ...]\n"
               "       python -m lightgbm_tpu chaos [--fast] [--cell ...]\n"
@@ -263,12 +265,33 @@ def main(argv: Optional[List[str]] = None) -> int:
               "       python -m lightgbm_tpu perf-gate [--update] "
               "[--skip-timing]\n"
               "tasks: train | predict | refit | save_binary | serve | "
-              "trace-doctor | chaos | monitor | perf-gate")
+              "ingest | trace-doctor | chaos | monitor | perf-gate")
         return 0
     # `python -m lightgbm_tpu serve model=...` — subcommand spelling of
     # task=serve (the reference CLI is key=value only; serve is ours)
     if argv[0] == "serve":
         argv = ["task=serve"] + argv[1:]
+    # `ingest` — out-of-core shard construction (data/ingest.py):
+    # stream a CSV/npy/npz through the mergeable quantile sketch and
+    # write checksummed .lgbtpu shards the Dataset loader consumes
+    if argv[0] == "ingest":
+        params = _parse_argv(argv[1:])
+        conf_dir = params.pop("_conf_dir", None)
+        data = params.pop("data", None)
+        out = params.pop("out", params.pop("out_dir", None))
+        if not data or not out:
+            raise SystemExit("ingest needs data=<file> out=<dir>")
+        label = params.pop("label_file", None)
+        from .data import ingest as run_ingest
+        summary = run_ingest(
+            _resolve_path(data, conf_dir), _resolve_path(out, conf_dir),
+            params=params,
+            label=_resolve_path(label, conf_dir) if label else None)
+        print(f"Ingest complete: {summary['total_rows']} rows -> "
+              f"{summary['num_shards']} shards in {summary['out_dir']} "
+              f"({summary['shards_written']} written, "
+              f"{summary['shards_reused']} reused)")
+        return 0
     # `trace-doctor` — the static-analysis battery (analysis/doctor.py);
     # argparse-style flags, not key=value, so it dispatches before run()
     if argv[0] in ("trace-doctor", "trace_doctor"):

@@ -274,6 +274,29 @@ PARAMS: Dict[str, ParamSpec] = {
                                                 "is_save_binary_file")),
         _p("precise_float_parser", False, bool),
         _p("parser_config_file", "", str),
+        # -- out-of-core ingest / chunked training (data/) --
+        _p("out_of_core", "auto", str,
+           check=lambda v: v in ("auto", "on", "off"),
+           doc="chunked (non-device-resident) training from .lgbtpu "
+               "shard datasets: auto streams row chunks only when the "
+               "device capacity check rejects the resident layout, on "
+               "forces streaming, off always materializes (raising if "
+               "the device can't hold it)"),
+        _p("chunk_budget_mb", 64.0, float, check=lambda v: v > 0,
+           doc="per-buffer byte budget for streamed bin-matrix chunks; "
+               "the chunked trainer double-buffers, so peak staged "
+               "bytes are ~2x this and host RSS stays O(chunk), not "
+               "O(dataset)"),
+        _p("ingest_rows_per_shard", 262144, int, check=lambda v: v > 0,
+           doc="row count per .lgbtpu shard written by `python -m "
+               "lightgbm_tpu ingest` (fixed partition: retries of an "
+               "interrupted ingest rewrite only missing/invalid "
+               "shards)"),
+        _p("sketch_capacity", 65536, int, check=lambda v: v >= 2,
+           doc="distinct values kept per feature by the ingest "
+               "quantile sketch before deterministic mantissa-"
+               "truncation coarsening (data/sketch.py documents the "
+               "2^(level-52) relative accuracy bound)"),
         # -- predict --
         _p("start_iteration_predict", 0, int),
         _p("num_iteration_predict", -1, int),

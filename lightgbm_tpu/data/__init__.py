@@ -1,0 +1,28 @@
+"""Out-of-core ingest subsystem.
+
+Streams datasets that do not fit in host RAM or device HBM:
+
+- :mod:`.reader` — chunked readers (CSV/TSV/LibSVM, ``.npy``/``.npz``,
+  arrays, ``Sequence`` objects) yielding fixed-size row blocks.
+- :mod:`.sketch` — mergeable per-feature quantile sketches whose merge
+  is exactly associative/commutative; feeds
+  :meth:`lightgbm_tpu.binning.BinMapper.from_distinct`.
+- :mod:`.shardfile` — the versioned, checksummed, mmap-able ``.lgbtpu``
+  binned shard format.
+- :mod:`.ingest` — the two-pass (sketch, then bin+write) ingest driver
+  behind ``python -m lightgbm_tpu ingest``.
+- :mod:`.chunked` — the chunked training driver: double-buffered
+  host→device prefetch with per-chunk histogram accumulation.
+"""
+
+from .sketch import FeatureSketch, SketchSet  # noqa: F401
+from .shardfile import (  # noqa: F401
+    SHARD_SUFFIX, ShardFormatError, ShardReader, is_shard_path,
+    list_shards, open_shard_dir, write_shard,
+)
+from .reader import open_chunk_reader  # noqa: F401
+from .ingest import ingest  # noqa: F401
+from .prefetch import ChunkPrefetcher, chunk_rows_for  # noqa: F401
+from .chunked import (  # noqa: F401
+    ArraySource, ChunkedTreeBuilder, ShardSource,
+)

@@ -236,7 +236,8 @@ class Dataset:
         self.pandas_categorical = None   # per-cat-column category lists
         self.raw_values: Optional[np.ndarray] = None  # kept for linear_tree
         self.bundle_plan = None                     # EFB layout (efb.py)
-        self.bins: Optional[np.ndarray] = None      # [num_data, F|G] int
+        self.bins = None                            # [num_data, F|G] int
+        self.chunk_source = None   # shard-backed row stream (data/)
         self.num_data: int = 0
         # True once the multi-host loader kept only this process's row
         # block (learners that need FULL rows per worker check this)
@@ -245,6 +246,27 @@ class Dataset:
         self.used_features: Optional[np.ndarray] = None  # indices of
         # non-trivial features actually trained on
         self._constructed = False
+
+    # ------------------------------------------------------------------
+    @property
+    def bins(self) -> Optional[np.ndarray]:
+        """[num_data, F|G] binned matrix. Shard-backed datasets keep it
+        on disk (``chunk_source``) and materialize HERE, lazily, only
+        when a resident consumer (save_binary, subset, a non-chunked
+        trainer fallback) actually reads it — the chunked trainer never
+        does."""
+        if self._bins is None and self.chunk_source is not None:
+            src = self.chunk_source
+            step = 1 << 16
+            self._bins = np.concatenate(
+                [np.asarray(src.read_rows(lo, min(lo + step,
+                                                  src.num_rows)))
+                 for lo in range(0, src.num_rows, step)])
+        return self._bins
+
+    @bins.setter
+    def bins(self, value) -> None:
+        self._bins = value
 
     # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
@@ -261,6 +283,13 @@ class Dataset:
             return self._construct_from_sequences()
         file_names: Optional[List[str]] = None
         from_file = isinstance(self._raw_data, (str, os.PathLike))
+        if from_file:
+            from .data.shardfile import is_shard_path
+            if is_shard_path(self._raw_data):
+                # pre-binned .lgbtpu shard dataset (`python -m
+                # lightgbm_tpu ingest` output): metadata restores from
+                # the shard headers, rows stream from the mmaps
+                return self._construct_from_shards(self._raw_data)
         if from_file and self._is_binary_file(self._raw_data):
             # binary dataset cache (LoadFromBinFile analog): restores
             # the constructed state directly, no parsing or re-binning
@@ -461,22 +490,62 @@ class Dataset:
         self._constructed = True
         return self
 
+    def _construct_from_shards(self, path) -> "Dataset":
+        """Construct from a ``.lgbtpu`` shard directory: every shard is
+        validated (checksum + set completeness), BinMappers restore from
+        the shard headers, and the binned rows stay mmap-backed behind
+        ``chunk_source`` for the chunked trainer."""
+        from .data.chunked import ShardSource
+        from .data.shardfile import open_shard_dir
+        if self._multi_process():
+            raise NotImplementedError(
+                "shard datasets load single-host (the chunked trainer "
+                "is serial; pre-partition shards per host instead)")
+        readers, h0 = open_shard_dir(str(path))
+        self.bin_mappers = readers[0].mappers()
+        self.num_total_features = int(h0["num_total_features"])
+        self.used_features = np.asarray(h0["used_features"], np.int64)
+        self.max_num_bin = int(h0["max_num_bin"])
+        if not (isinstance(self.feature_name, (list, tuple))
+                and self.feature_name):
+            self.feature_name = list(h0["feature_names"])
+        self.num_data = int(h0["total_rows"])
+        if self.label is None and h0.get("has_label"):
+            self.label = np.concatenate(
+                [np.asarray(r.label, np.float64) for r in readers])
+        if self.weight is None and h0.get("has_weight"):
+            self.weight = np.concatenate(
+                [np.asarray(r.weight, np.float64) for r in readers])
+        self.bundle_plan = None   # shards store unbundled feature space
+        self.chunk_source = ShardSource(readers)
+        if self.label is None and not self.params.get("_allow_no_label"):
+            raise ValueError("Dataset has no label")
+        if self.config.linear_tree:
+            raise ValueError(
+                "linear_tree needs dense raw feature values; shard "
+                "datasets carry only binned rows")
+        self.raw_values = None
+        if self.free_raw_data:
+            self._raw_data = None
+        self._constructed = True
+        return self
+
     def _construct_from_sequences(self) -> "Dataset":
         """Two-round streaming load from Sequence objects: a sampled
-        pass fits BinMappers, then batches are binned row-block by
-        row-block — the full raw matrix never exists in memory
-        (basic.py _init_from_sample + _push_rows flow)."""
+        pass fits BinMappers, then blocks stream through the shared
+        chunked reader (:class:`lightgbm_tpu.data.reader.
+        SequenceChunkReader`) and are binned row-block by row-block —
+        the full raw matrix never exists in memory (basic.py
+        _init_from_sample + _push_rows flow)."""
         cfg = self.config
         if self._multi_process() and not bool(cfg.pre_partition):
             raise NotImplementedError(
                 "multi-host Sequence ingestion requires pre-partitioned "
                 "sequences per host (pre_partition=true)")
-        seqs = (self._raw_data if isinstance(self._raw_data, list)
-                else [self._raw_data])
-        lens = [len(s) for s in seqs]
-        self.num_data = int(sum(lens))
-        first = np.asarray(seqs[0][0], dtype=np.float64)
-        self.num_total_features = int(first.reshape(-1).shape[0])
+        from .data.reader import DEFAULT_CHUNK_ROWS, SequenceChunkReader
+        reader = SequenceChunkReader(self._raw_data)
+        self.num_data = int(reader.num_rows)
+        self.num_total_features = int(reader.num_features)
         if self.reference is not None:
             ref = self.reference
             if self.num_total_features != ref.num_total_features:
@@ -494,22 +563,12 @@ class Dataset:
         self.feature_name = names
         cat_idx = self._resolve_categoricals(names)
 
-        starts = np.concatenate([[0], np.cumsum(lens)])
-
-        def fetch_rows(global_idx: np.ndarray) -> np.ndarray:
-            out = np.empty((len(global_idx), self.num_total_features))
-            for i, gi in enumerate(global_idx):
-                si = int(np.searchsorted(starts, gi, side="right") - 1)
-                out[i] = np.asarray(seqs[si][int(gi - starts[si])],
-                                    dtype=np.float64).reshape(-1)
-            return out
-
         if self.reference is None:
             sample_cnt = min(cfg.bin_construct_sample_cnt, self.num_data)
             rng = np.random.RandomState(cfg.data_random_seed)
             sample_idx = np.sort(rng.choice(self.num_data, sample_cnt,
                                             replace=False))
-            sample = fetch_rows(sample_idx)
+            sample = reader.read_rows_at(sample_idx)
             self._fit_mappers(sample, cat_idx, cfg)
             self.bundle_plan = None  # streaming path stays unbundled
 
@@ -526,24 +585,20 @@ class Dataset:
             dtype = np.uint8 if self.max_num_bin <= 256 else np.int32
             self.bins = np.empty((self.num_data, F), dtype=dtype)
         row0 = 0
-        for s in seqs:
-            bs = int(getattr(s, "batch_size", 4096) or 4096)
-            for lo in range(0, len(s), bs):
-                batch = np.asarray(s[lo:lo + bs], dtype=np.float64)
-                if batch.ndim == 1:
-                    batch = batch[None, :]
-                r = batch.shape[0]
-                batch_bins = np.empty((r, F), np.int64)
-                for j, f in enumerate(self.used_features):
-                    batch_bins[:, j] = self.bin_mappers[f].values_to_bins(
-                        batch[:, f])
-                if self.bundle_plan is not None:
-                    from .efb import encode_rows
-                    encode_rows(self.bundle_plan, batch_bins, self.bins,
-                                row0)
-                else:
-                    self.bins[row0:row0 + r] = batch_bins.astype(dtype)
-                row0 += r
+        for chunk in reader.iter_chunks(DEFAULT_CHUNK_ROWS):
+            batch = chunk.X
+            r = batch.shape[0]
+            batch_bins = np.empty((r, F), np.int64)
+            for j, f in enumerate(self.used_features):
+                batch_bins[:, j] = self.bin_mappers[f].values_to_bins(
+                    batch[:, f])
+            if self.bundle_plan is not None:
+                from .efb import encode_rows
+                encode_rows(self.bundle_plan, batch_bins, self.bins,
+                            row0)
+            else:
+                self.bins[row0:row0 + r] = batch_bins.astype(dtype)
+            row0 += r
         assert row0 == self.num_data
 
         if self.label is None and not self.params.get("_allow_no_label"):

@@ -238,7 +238,8 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                      impl: str = "auto", merge=True,
                      n_shards: int = 1,
                      row_gather: Optional[jax.Array] = None,
-                     num_rows: Optional[jax.Array] = None) -> jax.Array:
+                     num_rows: Optional[jax.Array] = None,
+                     init: Optional[jax.Array] = None) -> jax.Array:
     """Accumulate per-(leaf, feature, bin) sums of (grad, hess, count).
 
     Args:
@@ -295,6 +296,24 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
     honors ``row_gather`` by materializing the gathered bins (correct
     but not yet a bandwidth win; its grid is static).
 
+    Carried accumulation (out-of-core, data/chunked.py): ``init``
+    [L, F, B, CH] seeds the accumulator, so a row stream too large for
+    device memory can be fed chunk by chunk — chunk k's result becomes
+    chunk k+1's ``init``. On the matmul and scatter paths the seed IS
+    the internal scan carry (re-laid-out, not post-added), so chunked
+    accumulation over aligned block boundaries is bit-identical to one
+    resident pass: both already reduce block-sequentially, the seed
+    just replaces the zeros block. ``block_rows`` is independent of R
+    (:func:`_pick_block_rows` sizes by F*B only), so a caller that pads
+    every chunk to the same ``block_rows`` multiple gets identical
+    block shapes — and identical addition order — in both regimes.
+    Native/pallas add ``init`` after their kernel (exact for int32
+    histograms, order-shifted for f32 — the chunked driver pins
+    matmul/scatter). With ``axis_name`` set, ``init`` must be the
+    shard-local PRE-merge accumulator (it is added before the
+    collective); the chunked driver is serial-only so this does not
+    arise in practice.
+
     Returns: [L, F, B, 3] float32 (int32 when gh is int8).
     """
     R, F = bins.shape
@@ -320,6 +339,8 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
         hist = build_histograms_pallas(
             bins_p, gh, row_leaf, leaf_ids, num_bins=B,
             hist_dtype=hist_dtype, num_rows=num_rows)
+        if init is not None:
+            hist = hist + init
         # honor merge=False: feature-parallel slots are feature-disjoint
         # and voting merges elected columns itself — an unconditional
         # psum here was a pure-waste no-op for the former and would
@@ -354,6 +375,8 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
                 bins, gh, row_leaf.astype(jnp.int32),
                 leaf_ids.astype(jnp.int32), rg_in, nr_in,
                 bf16_round=bf16_round, use_gather=has_rg)
+            if init is not None:
+                hist = hist + init
             if axis_name is not None:
                 # custom-call results come back unvarying; restore the
                 # manual-axis type before the merge / loop carry
@@ -405,7 +428,14 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
             return acc.at[flat.reshape(-1)].add(
                 vals.reshape(block_rows * F, HIST_CH))
 
-        acc0 = jnp.zeros(((L + 1) * F * B, HIST_CH), dtype=acc_dt)
+        if init is not None:
+            # seed the real slots, keep the spill slot zeroed — spill
+            # rows are dropped below so their stale sums never surface
+            acc0 = jnp.concatenate(
+                [init.astype(acc_dt).reshape(L * F * B, HIST_CH),
+                 jnp.zeros((F * B, HIST_CH), dtype=acc_dt)], axis=0)
+        else:
+            acc0 = jnp.zeros(((L + 1) * F * B, HIST_CH), dtype=acc_dt)
         if axis_name is not None:
             acc0 = _pvary(acc0, axis_name)
         if dyn:
@@ -435,7 +465,13 @@ def build_histograms(bins: jax.Array, gh: jax.Array, row_leaf: jax.Array,
             precision=None if quant else prec,
             preferred_element_type=acc_dt)
 
-    acc0 = jnp.zeros((F * B, L * HIST_CH), dtype=acc_dt)
+    if init is not None:
+        # inverse of the output layout transform below: [L,F,B,CH] ->
+        # [F*B, L*CH] so the seed IS the matmul accumulator carry
+        acc0 = init.astype(acc_dt).transpose(1, 2, 0, 3).reshape(
+            F * B, L * HIST_CH)
+    else:
+        acc0 = jnp.zeros((F * B, L * HIST_CH), dtype=acc_dt)
     if axis_name is not None:
         # inside shard_map the blocked inputs vary over the mapped axis;
         # the loop carry must carry the same varying-axis type

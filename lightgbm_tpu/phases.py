@@ -24,8 +24,9 @@ ValueError, never a silent attribution miss.
 from __future__ import annotations
 
 __all__ = ["GRADS", "SAMPLING", "BUILD", "UPDATE", "EVAL",
+           "INGEST_SKETCH", "INGEST_WRITE", "PREFETCH",
            "HIST_MERGE", "WINNER_SYNC", "TRAIN_PHASES",
-           "COLLECTIVE_PHASES", "KNOWN_PHASES"]
+           "INGEST_PHASES", "COLLECTIVE_PHASES", "KNOWN_PHASES"]
 
 # training phases (both drivers, boosting/gbdt.py + engine.train's eval)
 GRADS = "grads"
@@ -34,6 +35,13 @@ BUILD = "build"
 UPDATE = "update"
 EVAL = "eval"
 
+# out-of-core ingest/streaming phases (data/ingest.py sketch + shard
+# write passes; data/prefetch.py host->device staging during chunked
+# training)
+INGEST_SKETCH = "ingest_sketch"
+INGEST_WRITE = "ingest_write"
+PREFETCH = "prefetch"
+
 # collective phases (ops/histogram.merge_histograms,
 # boosting/tree_builder._sync_best) — these reach compiled HLO as
 # op-name prefixes and carry the auditors' traffic attribution
@@ -41,5 +49,6 @@ HIST_MERGE = "hist_merge"
 WINNER_SYNC = "winner_sync"
 
 TRAIN_PHASES = frozenset({GRADS, SAMPLING, BUILD, UPDATE, EVAL})
+INGEST_PHASES = frozenset({INGEST_SKETCH, INGEST_WRITE, PREFETCH})
 COLLECTIVE_PHASES = frozenset({HIST_MERGE, WINNER_SYNC})
-KNOWN_PHASES = TRAIN_PHASES | COLLECTIVE_PHASES
+KNOWN_PHASES = TRAIN_PHASES | INGEST_PHASES | COLLECTIVE_PHASES

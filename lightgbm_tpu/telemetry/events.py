@@ -59,6 +59,10 @@ EVENT_TYPES: Dict[str, tuple] = {
     "train_end": ("iter", "trees", "wall_s"),
     "cost_model": ("label", "flops", "bytes_accessed"),
     "perf_gate": ("status", "checked", "failed"),
+    # out-of-core ingest (data/ingest.py): one record per completed
+    # pass; shard writes are individually atomic so the log is
+    # observability, not recovery state
+    "ingest": ("action", "rows", "shards"),
 }
 
 

@@ -95,6 +95,13 @@ DEFAULT_TOLERANCES: Dict[str, Tolerance] = {
     "cost_fused_step_n_ops": Tolerance("static", 1.25),
     "cost_predict_flops": Tolerance("static", 1.25),
     "cost_predict_bytes": Tolerance("static", 1.25),
+    # out-of-core probe (ISSUE 13): wall-clock/throughput on shared CI
+    # hosts, so the bands are wide; overlap_fraction is host-scheduling
+    # dependent and only gates a total collapse
+    "ingest_rows_per_s": Tolerance("throughput", 2.5),
+    "ingest_chunked_ms_per_tree": Tolerance("time", 2.5),
+    "ingest_resident_ms_per_tree": Tolerance("time", 2.5),
+    "ingest_prefetch_overlap": Tolerance("throughput", 10.0),
 }
 _DEFAULT = Tolerance("static", 1.5)
 

@@ -27,6 +27,15 @@ cell (param set) on deterministic synthetic data:
   point), a re-emitted run header carrying the same config
   fingerprint, and a log that passes the ``monitor --check`` schema
   self-check end to end.
+- **ingest** (``--ingest``) — out-of-core ingest crash safety: the
+  shard writer is SIGKILLed right after its Nth shard lands
+  (``LIGHTGBM_TPU_CHAOS_KILL_SHARD``). Everything left in the output
+  directory must be checksum-valid (atomic rename: no torn shard can
+  survive), and the retry must re-ingest ONLY the missing shards —
+  survivors keep their mtimes. Same contract after deleting one shard
+  and bit-flipping another. A model trained from the repaired
+  directory must be bit-identical to one trained from an
+  uninterrupted ingest of the same source.
 - **elastic** (``--elastic``) — topology-portable resume: SIGKILL a
   run on mesh/plan topology A, resume the same directory on topology B
   (different virtual-device count, serial<->data-parallel,
@@ -43,6 +52,7 @@ the RNG-stream-sensitive configs.
 
 Run: python scripts/chaos_train.py [--fast] [--cell NAME ...]
      python scripts/chaos_train.py --elastic [--fast]
+     python scripts/chaos_train.py --ingest [--fast]
      python -m lightgbm_tpu chaos [--fast]
 Exit 0 when every assertion holds, 1 otherwise (the CI gate contract,
 alongside scripts/lint_traces.py).
@@ -122,6 +132,11 @@ ELASTIC_FAST = ("elastic/8rs-4rs", "elastic/8ar-serial1")
 ELASTIC_KILL = 5        # mid-run, off both cadence boundaries
 FLOAT_TOL = 5e-3        # |auc_resumed - auc_baseline| bound, float cell
 
+# -- ingest crash cell: kill the shard writer mid-pass -----------------
+INGEST_ROWS, INGEST_FEATS = 6000, 6
+INGEST_SHARD_ROWS = 1500           # -> 4 shards
+INGEST_KILL_AFTER = 2              # die right after shard 2 lands
+
 _CHILD = '''
 import json, os, sys
 import numpy as np
@@ -173,6 +188,20 @@ print("CHAOS=" + json.dumps({
     "trees_sha": hashlib.sha256(trees.encode()).hexdigest(),
     "eval_hist": {k: {m: list(v) for m, v in d.items()}
                   for k, d in hist.items()}}))
+'''
+
+_INGEST_CHILD = '''
+import json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from lightgbm_tpu.data.ingest import ingest
+
+params = json.loads(os.environ["CHAOS_PARAMS"])
+summary = ingest(os.environ["CHAOS_INGEST_X"],
+                 os.environ["CHAOS_INGEST_OUT"], params=params,
+                 label=os.environ["CHAOS_INGEST_Y"], verbose=False)
+print("CHAOS=" + json.dumps({k: summary[k] for k in
+                             ("num_shards", "shards_written",
+                              "shards_reused", "total_rows")}))
 '''
 
 
@@ -430,7 +459,142 @@ class Chaos:
             bool(reshards) == want,
             f"{len(reshards)} reshard records")
 
+    def _run_ingest_child(self, workdir, out_dir, x_path, y_path,
+                          params, extra=None):
+        """(payload|None, returncode) for one ingest subprocess."""
+        child = os.path.join(self.root, "_ingest_child.py")
+        if not os.path.exists(child):
+            with open(child, "w") as f:
+                f.write(_INGEST_CHILD)
+        env = dict(os.environ,
+                   PYTHONPATH=_probe.REPO_ROOT,
+                   JAX_PLATFORMS="cpu",
+                   CHAOS_PARAMS=json.dumps(params),
+                   CHAOS_INGEST_OUT=out_dir,
+                   CHAOS_INGEST_X=x_path, CHAOS_INGEST_Y=y_path,
+                   **(extra or {}))
+        r = subprocess.run([sys.executable, child], cwd=workdir,
+                           env=env, capture_output=True, text=True,
+                           timeout=600.0)
+        payload = None
+        for ln in r.stdout.splitlines():
+            if ln.startswith("CHAOS="):
+                payload = json.loads(ln.split("=", 1)[1])
+        if payload is None and r.returncode == 0:
+            print(r.stderr[-2000:], file=sys.stderr)
+        return payload, r.returncode
+
+    def ingest_chaos(self):
+        """SIGKILL the shard writer mid-pass; everything that survives
+        must be checksum-valid, the retry must rewrite ONLY what is
+        missing/invalid, and the repaired directory must train
+        bit-identically to an uninterrupted ingest."""
+        import glob
+
+        import numpy as np
+        if _probe.REPO_ROOT not in sys.path:
+            sys.path.insert(0, _probe.REPO_ROOT)
+        from lightgbm_tpu.data.shardfile import verify_shard
+
+        name = "ingest/kill-mid-write"
+        print(f"== {name} ==")
+        d = os.path.join(self.root, "ingest")
+        out = os.path.join(d, "shards")
+        os.makedirs(out, exist_ok=True)
+        rng = np.random.default_rng(13)
+        X = rng.normal(size=(INGEST_ROWS, INGEST_FEATS))
+        y = (X[:, 0] - X[:, 1] > 0).astype(np.float64)
+        x_path, y_path = (os.path.join(d, "X.npy"),
+                          os.path.join(d, "y.npy"))
+        np.save(x_path, X)
+        np.save(y_path, y)
+        params = dict(objective="binary", verbosity=-1,
+                      ingest_rows_per_shard=INGEST_SHARD_ROWS)
+
+        # 1. die right after shard INGEST_KILL_AFTER lands
+        _, rc = self._run_ingest_child(
+            d, out, x_path, y_path, params,
+            extra={"LIGHTGBM_TPU_CHAOS_KILL_SHARD":
+                   str(INGEST_KILL_AFTER)})
+        self.check(f"{name} SIGKILL death", rc == -signal.SIGKILL,
+                   f"rc={rc}")
+        survivors = sorted(glob.glob(os.path.join(out, "*.lgbtpu")))
+        all_valid = all(verify_shard(p) for p in survivors)
+        self.check(
+            f"{name} survivors checksum-valid",
+            len(survivors) == INGEST_KILL_AFTER and all_valid,
+            f"{len(survivors)} shards, valid={all_valid}")
+        mtimes = {p: os.path.getmtime(p) for p in survivors}
+
+        # 2. retry re-ingests only the missing shards
+        payload, rc = self._run_ingest_child(d, out, x_path, y_path,
+                                             params)
+        n = payload["num_shards"] if payload else -1
+        self.check(
+            f"{name} retry rewrites only missing",
+            rc == 0 and payload is not None
+            and payload["shards_reused"] == INGEST_KILL_AFTER
+            and payload["shards_written"] == n - INGEST_KILL_AFTER
+            and all(os.path.getmtime(p) == t
+                    for p, t in mtimes.items()),
+            f"rc={rc} payload={payload}")
+
+        # 3. delete one shard + bit-flip another: retry must detect and
+        # rewrite exactly those two
+        shards = sorted(glob.glob(os.path.join(out, "*.lgbtpu")))
+        if len(shards) >= 4:
+            os.unlink(shards[0])
+            with open(shards[3], "r+b") as f:
+                f.seek(100)
+                f.write(b"\xff\xff\xff\xff")
+            keep = {p: os.path.getmtime(p) for p in shards[1:3]}
+            payload, rc = self._run_ingest_child(d, out, x_path,
+                                                 y_path, params)
+            self.check(
+                f"{name} delete+corrupt repair",
+                rc == 0 and payload is not None
+                and payload["shards_written"] == 2
+                and payload["shards_reused"] == len(shards) - 2
+                and all(os.path.getmtime(p) == t
+                        for p, t in keep.items()),
+                f"rc={rc} payload={payload}")
+
+        # 4. the repaired directory trains bit-identically to a fresh
+        # uninterrupted ingest of the same source
+        if not self.fast:
+            from lightgbm_tpu.data.ingest import ingest as _ingest
+
+            import lightgbm_tpu as lgb
+            ref = os.path.join(d, "shards_ref")
+            _ingest(x_path, ref, params=params, label=y_path,
+                    verbose=False)
+            tp = dict(objective="binary", num_leaves=15, verbosity=-1,
+                      min_data_in_leaf=5, deterministic=True,
+                      chunk_budget_mb=0.05)
+            m_rep = lgb.train(dict(tp), lgb.Dataset(out,
+                                                    params=dict(tp)),
+                              num_boost_round=5)
+            m_ref = lgb.train(dict(tp), lgb.Dataset(ref,
+                                                    params=dict(tp)),
+                              num_boost_round=5)
+            self.check(
+                f"{name} repaired dir trains bit-identical",
+                np.array_equal(m_rep.predict(X), m_ref.predict(X)))
+
     # -- driver --------------------------------------------------------
+
+    def run_ingest(self):
+        try:
+            self.ingest_chaos()
+        finally:
+            shutil.rmtree(self.root, ignore_errors=True)
+        print(f"chaos_train: {self.passes} passed, "
+              f"{len(self.failures)} failed")
+        if self.failures:
+            for f in self.failures:
+                print(f"  FAILED: {f}", file=sys.stderr)
+            return 1
+        return 0
 
     def run_elastic(self, names):
         try:
@@ -497,7 +661,13 @@ def main(argv=None) -> int:
                    help="run the topology-portable resume matrix "
                         "(kill at topology A, resume at B) instead of "
                         "the kill/corrupt/poison flows")
+    p.add_argument("--ingest", action="store_true",
+                   help="run the out-of-core ingest crash cell "
+                        "(SIGKILL mid shard-write, idempotent retry) "
+                        "instead of the kill/corrupt/poison flows")
     ns = p.parse_args(argv)
+    if ns.ingest:
+        return Chaos(fast=ns.fast).run_ingest()
     if ns.elastic:
         names = ([c for c in (ns.cells or []) if c in ELASTIC_CELLS]
                  or list(ELASTIC_FAST if ns.fast else ELASTIC_CELLS))

@@ -41,6 +41,9 @@ python scripts/lint_traces.py $fast || rc=1
 echo "== chaos elastic (topology-portable resume) =="
 python scripts/chaos_train.py --elastic $fast || rc=1
 
+echo "== chaos ingest (out-of-core crash safety) =="
+python scripts/chaos_train.py --ingest $fast || rc=1
+
 # Perf gate: static cost-model metrics vs PERF_BASELINE.json (timing
 # compares only when the host is quiet — the gate decides via loadavg),
 # then the self-test: a seeded 2x regression MUST trip the gate.

@@ -47,6 +47,8 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # critical path), no eval sets
 N_ROWS, N_FEATS, NUM_LEAVES = 20_000, 16, 31
 WARMUP_ROUNDS, TIMED_ROUNDS = 8, 40
+# out-of-core probe workload (bench.ingest_bench shares the shape)
+INGEST_ROWS, INGEST_ITERS = 1 << 16, 6
 # histogram probe lattice — identical to bench.probe_hist_impl so the
 # two surfaces gate the same program
 HIST_R, HIST_F, HIST_B, HIST_L = 1 << 17, 28, 63, 21
@@ -98,12 +100,17 @@ def collect_metrics(skip_timing: bool = False
 
     # steady-state timing (quiet host only — loadavg says whether a
     # wall-clock number would measure us or the neighbours)
+    _INGEST_METRICS = ("ingest_rows_per_s", "ingest_prefetch_overlap",
+                       "ingest_chunked_ms_per_tree",
+                       "ingest_resident_ms_per_tree")
     if skip_timing:
         skipped.append("ms_per_tree")
+        skipped.extend(_INGEST_METRICS)
     elif not perf.host_quiet():
         print("perf-gate: host not quiet (loadavg); skipping timing",
               file=sys.stderr)
         skipped.append("ms_per_tree")
+        skipped.extend(_INGEST_METRICS)
     else:
         gb = bst._gbdt
         for _ in range(WARMUP_ROUNDS):
@@ -115,6 +122,17 @@ def collect_metrics(skip_timing: bool = False
         gb.sync()
         metrics["ms_per_tree"] = ((time.perf_counter() - t0) * 1e3
                                   / TIMED_ROUNDS)
+        # out-of-core probe (ISSUE 13): shares bench.py's ingest_bench
+        # so the gate and the bench price the same path
+        try:
+            from bench import ingest_bench
+            ing = ingest_bench(rows=INGEST_ROWS, iters=INGEST_ITERS)
+            metrics.update({k: float(v) for k, v in ing.items()
+                            if k in _INGEST_METRICS})
+        except Exception as e:  # noqa: BLE001 — probe must not kill gate
+            print(f"perf-gate: ingest probe failed ({e}); skipping",
+                  file=sys.stderr)
+            skipped.extend(_INGEST_METRICS)
     return metrics, skipped
 
 
