@@ -288,6 +288,66 @@ def probe_hist_impl(platform: str) -> dict:
             1.0 - quant_bytes / full_bytes, 3)
     except Exception as e:
         print(f"quant probe failed: {e}", file=sys.stderr)
+    # split-scan ablation (ISSUE 14): the standalone find_best_splits
+    # pass the fused kernel absorbs — its wall-clock is the latency the
+    # fusion removes, and on every platform the analytical byte counts
+    # prove the [F, B, L, 3] HBM round-trip is gone from the fused path
+    try:
+        import jax.numpy as jnp
+        from lightgbm_tpu.ops.split import SplitParams, find_best_splits
+        from lightgbm_tpu.telemetry.costmodel import (
+            analytical_build_split_counts)
+        sp = SplitParams(min_data_in_leaf=20,
+                         min_sum_hessian_in_leaf=1e-3)
+        nb_pf = jnp.full((F,), B, jnp.int32)
+        nan_pf = jnp.full((F,), -1, jnp.int32)
+        cat_pf = jnp.zeros((F,), bool)
+        hraw = rng.normal(size=(L, F, B, 3)).astype(np.float32)
+        hraw[..., 1:] = np.abs(hraw[..., 1:]) * 8.0
+        hist = jnp.asarray(hraw)
+        scan = jax.jit(lambda h: find_best_splits(
+            h, nb_pf, nan_pf, cat_pf, sp)["gain"])
+        scan(hist).block_until_ready()
+        t0 = time.time()
+        for _ in range(5):
+            gv = scan(hist)
+        gv.block_until_ready()
+        t_scan = (time.time() - t0) / 5
+        out["split_scan_ms"] = round(t_scan * 1e3, 2)
+        _, by2 = analytical_build_split_counts(R, F, B, L, fused=False)
+        _, byf = analytical_build_split_counts(R, F, B, L, fused=True)
+        out["hist_bytes_twopass"] = int(by2)
+        out["hist_bytes_fused"] = int(byf)
+        out["hist_fused_bytes_reduction"] = round(1.0 - byf / by2, 3)
+    except Exception as e:
+        t_scan = None
+        print(f"split scan probe failed: {e}", file=sys.stderr)
+    if platform == "tpu":
+        # the fused build+split pass itself (pure mode — no histogram
+        # leaves VMEM); its time replaces hist + split_scan end to end
+        try:
+            from lightgbm_tpu.ops.pallas_histogram import (
+                fused_build_best_splits, fused_plan_ok)
+            assert fused_plan_ok(F, B, L)
+
+            def fnf():
+                best, _ = fused_build_best_splits(
+                    bins, gh, rl, lids, num_bins=B, params=sp,
+                    num_bins_pf=nb_pf, nan_bin_pf=nan_pf,
+                    is_cat_pf=cat_pf, hist_dtype="bfloat16")
+                return best["gain"]
+            fused_j = jax.jit(fnf)
+            fused_j().block_until_ready()
+            t0 = time.time()
+            for _ in range(5):
+                gv = fused_j()
+            gv.block_until_ready()
+            t_fused = (time.time() - t0) / 5
+            out["hist_fused_ms"] = round(t_fused * 1e3, 2)
+            out["hist_hbm_gbps_fused"] = round(
+                out["hist_bytes_fused"] / t_fused / 1e9, 2)
+        except Exception as e:
+            print(f"fused split probe failed: {e}", file=sys.stderr)
     if platform == "tpu":
         # histogram-subtraction ablation evidence: if doubling the leaf
         # batch costs ~nothing (the matmul N dim pads to 128 anyway),
@@ -306,6 +366,13 @@ def probe_hist_impl(platform: str) -> dict:
                     else bench_one(out["hist_impl"]))
         out["hist_ms"] = round(t_chosen * 1e3, 2)
         out.update(kernel_roofline_fields(platform, t_chosen, R, F, B, L))
+        # effective bandwidth of the whole build+split pass: two-pass
+        # prices hist + scan wall-clock against bytes that include the
+        # lattice re-read; the fused field above prices one kernel
+        # against a byte count with no lattice round-trip at all
+        if t_scan is not None and out.get("hist_bytes_twopass"):
+            out["hist_hbm_gbps_twopass"] = round(
+                out["hist_bytes_twopass"] / (t_chosen + t_scan) / 1e9, 2)
     except Exception as e:
         print(f"roofline probe failed: {e}", file=sys.stderr)
     # XLA's own price of the MXU formulation next to the analytical one

@@ -27,7 +27,8 @@ from .hlo_walk import (HloOp, COLLECTIVE_KINDS, parse_ops,  # noqa: F401
                        lower_hlo)
 from .recompile_guard import (RecompileGuard,  # noqa: F401
                               RecompileError, cache_size)
-from .doctor import run_doctor, doctor_main, CANONICAL_CONFIGS  # noqa: F401
+from .doctor import (run_doctor, doctor_main,  # noqa: F401
+                     doctor_fused_split, CANONICAL_CONFIGS)
 
 __all__ = [
     "Finding", "TraceReport", "merge_errors",
@@ -35,5 +36,6 @@ __all__ = [
     "HloOp", "COLLECTIVE_KINDS", "parse_ops", "parse_collective_ops",
     "input_output_aliases", "lower_hlo",
     "RecompileGuard", "RecompileError", "cache_size",
-    "run_doctor", "doctor_main", "CANONICAL_CONFIGS",
+    "run_doctor", "doctor_main", "doctor_fused_split",
+    "CANONICAL_CONFIGS",
 ]

@@ -24,6 +24,10 @@ TD005     jaxpr     class-unrolled build: more ``build``-phase grow
                     loops staged per program than the caller's budget
                     (a multiclass iteration tracing K sequential tree
                     builds instead of one class-batched build)
+TD007     hlo       full ``[.., F, B, 3]`` histogram lattice staged in
+                    the fused build+split program (the VMEM-residency
+                    contract of the fused Pallas epilogue: only
+                    candidate records may leave the kernel)
 TD101     hlo       oversized dense ``constant`` op in the compiled
                     program
 TD102     hlo       host transfer (infeed/outfeed/send/recv, callback

@@ -41,6 +41,7 @@ import numpy as np
 from ..config import Config
 from ..dataset import Dataset
 from ..objectives import Objective
+from ..ops import pallas_histogram as PH
 from ..ops.histogram import block_rows_for, resolve_impl
 from ..ops.split import SplitParams
 from ..tree import Tree
@@ -704,6 +705,12 @@ class GBDT:
             self._hist_sub = _hist_sub_gate(
                 self.K * (-(-_lattice // n_fs)))
 
+        # fused Pallas build+split (ISSUE 14): decided eagerly (the
+        # probe compiles outside any trace) so telemetry can name the
+        # binding gate; both tree builders read the flag
+        self.fused_split_reason = self._fused_split_reason()
+        self.fused_split_ok = not self.fused_split_reason
+
         # decide the iteration driver LAST (the gate reads _cegb/_mp/...)
         self.fused_reason = self._fused_gate_reason()
         self.fused_ok = not self.fused_reason
@@ -726,7 +733,8 @@ class GBDT:
                 hist_dtype=config.hist_dtype,
                 hist_impl=config.hist_impl,
                 block_rows=self.block,
-                cat_sorted_mask=self._cat_sorted_mask)
+                cat_sorted_mask=self._cat_sorted_mask,
+                hist_sub=self._hist_sub)
 
     # ------------------------------------------------------------------
     def _field_init_scores(self, init, n: int, r_pad: int) -> np.ndarray:
@@ -1047,6 +1055,8 @@ class GBDT:
             if self._bins_cm is None:
                 self._bins_cm = jnp.asarray(self.train_dd.bins.T)
             kw["bins_cm"] = self._bins_cm
+        if self.fused_split_ok:
+            kw["fused_split"] = True
         mono_method = (cfg.monotone_constraints_method
                        if self.mono_type_pf is not None else "basic")
         leaf_batch = cfg.leaf_batch
@@ -1111,6 +1121,59 @@ class GBDT:
             return "per-node feature sampling draws inside the builder"
         if bool(cfg.extra_trees):
             return "extra-trees thresholds draw inside the builder"
+        return ""
+
+    # -- fused Pallas build+split (ISSUE 14) ---------------------------
+
+    def _fused_split_reason(self) -> str:
+        """Why the fused histogram+split-find Pallas kernel cannot
+        drive this run's split search ('' = it can). The kernel's
+        epilogue evaluates the gain lattice on the VMEM-resident
+        accumulator block and emits only per-(leaf, chunk) candidate
+        records, so anything that needs the full [F, B, 3] histogram
+        in HBM — merge collectives, EFB unbundling, sorted-subset
+        categorical reordering, gain rescaling, random thresholds —
+        pins the two-pass kernel + ``find_best_splits`` path. Mirrors
+        tree_builder's trace-time ``use_fused`` gate (which still
+        falls back silently if a traced shape disagrees)."""
+        import os
+        cfg = self.config
+        env = os.environ.get("LIGHTGBM_TPU_FUSED_SPLIT", "")
+        if env == "0":
+            return "LIGHTGBM_TPU_FUSED_SPLIT=0"
+        mode = "on" if env == "1" else str(cfg.fused_split)
+        if mode == "off":
+            return "fused_split=off"
+        impl = resolve_impl(cfg.hist_impl)
+        if impl != "pallas":
+            return f"hist_impl resolves to {impl} (epilogue is Pallas)"
+        if self.chunked:
+            return "chunked rounds accumulate histograms across chunks"
+        if self.plan is not None:
+            return "parallel plans merge full histograms"
+        if self._bundle_meta is not None:
+            return "EFB bundles unbundle the full histogram"
+        if bool(cfg.extra_trees):
+            return "extra-trees thresholds sample the full lattice"
+        if self._forced_splits is not None:
+            return "forced splits gather arbitrary (feature, bin) cells"
+        if self._cegb is not None:
+            return "CEGB rescales gains outside the kernel"
+        if self._gain_scale is not None:
+            return "feature_contri rescales gains outside the kernel"
+        if self._cat_sorted_mask is not None:
+            return "sorted-subset categoricals reorder histogram bins"
+        if (self.mono_type_pf is not None
+                and cfg.monotone_constraints_method == "advanced"):
+            return "advanced monotone re-reads sibling histograms"
+        F = self.train_set.num_features
+        W = max(1, min(int(cfg.leaf_batch), int(cfg.num_leaves) - 1))
+        if not (PH.fused_plan_ok(F, self.B, 2 * W)
+                and PH.fused_plan_ok(F, self.B, W)):
+            return (f"chunk plan unaligned for (F={F}, B={self.B}, "
+                    f"W={W})")
+        if mode != "on" and not PH.fused_probe_ok():
+            return "fused probe failed to compile on this backend"
         return ""
 
     # -- class-batched multiclass build (ISSUE 8) ----------------------
@@ -1193,6 +1256,8 @@ class GBDT:
             kw["bundle_bins"] = self._bundle_bins
         if self.plan is None and self._gain_scale is not None:
             kw["gain_scale"] = self._gain_scale
+        if self.fused_split_ok:
+            kw["fused_split"] = True
         mono_method = (cfg.monotone_constraints_method
                        if self.mono_type_pf is not None else "basic")
         leaf_batch = cfg.leaf_batch

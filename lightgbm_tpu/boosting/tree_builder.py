@@ -75,9 +75,12 @@ from ..native import jax_ffi as _jax_ffi
 
 from ..ops.histogram import (build_histograms, resolve_impl, HIST_CH,
                              merge_histograms, _pvary)
+# referenced as a module attribute (PH.fused_build_best_splits) so tests
+# can monkeypatch interpret-mode wrappers in
+from ..ops import pallas_histogram as PH
 from ..ops.predict import row_feature_gather
 from ..ops.split import (SplitParams, find_best_splits, leaf_gain,
-                         leaf_output)
+                         leaf_output, monotone_penalty_factor)
 
 __all__ = ["TreeArrays", "build_tree", "max_rounds_for"]
 
@@ -179,7 +182,9 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
                bins_cm: Optional[jax.Array] = None,
                feature_sharded: bool = False,
                hist_merge: str = "allreduce",
-               n_shards: int = 1):
+               n_shards: int = 1,
+               fused_split: bool = False,
+               root_hist: Optional[jax.Array] = None):
     """Grow one tree. Returns (TreeArrays, row_leaf, valid_row_leafs).
 
     ``parallel_mode`` (with ``axis_name`` set) selects the distributed
@@ -479,6 +484,27 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         F_loc = loc_nbpf.shape[0]
     if feature_sharded and mode != "feature":
         raise ValueError("feature_sharded requires parallel_mode='feature'")
+
+    # Fused Pallas build+split (ISSUE 14): one VMEM-resident pass builds
+    # a leaf batch's histograms AND runs the split-find epilogue on the
+    # still-resident accumulator block, emitting only per-(leaf, chunk)
+    # candidate records to HBM — the [F, B, 3] histogram round-trip
+    # between the hist and split phases disappears. Gates (fall back to
+    # histogram kernel + find_best_splits) are the lattice features the
+    # epilogue can't express: sorted-subset categoricals, extra-trees
+    # random thresholds, gain scale/penalty (feature_contri, CEGB),
+    # advanced monotone bounds, forced-split gathers, every parallel /
+    # EFB / feature-sharded plan (they need the full histogram for the
+    # merge collective or subtraction), and unaligned chunk plans.
+    use_smooth = split_params.path_smooth > 0.0
+    pen_on = use_mono and split_params.monotone_penalty > 0.0
+    use_fused = bool(
+        fused_split and hist_impl == "pallas" and axis_name is None
+        and not use_bundle and not use_rand and not use_cegb
+        and not use_forced and not use_mono_adv
+        and gain_scale is None and cat_sorted_mask is None
+        and not feature_sharded
+        and PH.fused_plan_ok(F, B, 2 * W) and PH.fused_plan_ok(F, B, W))
 
     # quantized training: histograms come back int32 (exact); descale to
     # (sum_g, sum_h, count) f32 once per build — the single-pass analog of
@@ -949,6 +975,113 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             bs = _sync_best(bs)
         return bs
 
+    if use_fused:
+        iw = jnp.arange(W, dtype=jnp.int32)
+
+        def fused_call(slots, fmask_s, depth_s, lo, hi, po, rl,
+                       gh_in=None, row_gather=None, num_rows=None,
+                       emit_hist=False):
+            """One fused launch over a leaf-slot lattice. Mirrors the
+            metadata prep of best_for's serial arm; the kernel gates
+            smoothing/monotone internally on params, so unused operands
+            ride as zeros."""
+            pen = (monotone_penalty_factor(depth_s, sp.monotone_penalty)
+                   if pen_on else None)
+            mat = (bins if row_gather is None
+                   else jnp.take(bins, row_gather, axis=0))
+            return PH.fused_build_best_splits(
+                mat, gh if gh_in is None else gh_in, rl, slots,
+                num_bins=B, params=sp, num_bins_pf=num_bins_pf,
+                nan_bin_pf=nan_bin_pf, is_cat_pf=is_cat_pf,
+                feature_mask=fmask_s, mono_type=mono_type_pf,
+                leaf_lo=lo, leaf_hi=hi, parent_output=po, mono_pen=pen,
+                quant_scales=quant_scales, hist_dtype=hist_dtype,
+                num_rows=num_rows, emit_hist=emit_hist)
+
+        def fused_children(st, t, row_leaf, sel_s, right_slot, valid,
+                           slots2w, slots2w_c, depth2w, mid_state, keyr,
+                           leaf_lo, leaf_hi):
+            """Per-round children splits via the fused kernel. With the
+            subtraction cache on, only the SMALLER child is streamed
+            (fused, emitting its histogram for the cache); the sibling
+            is parent-minus-child from the cache and scanned directly —
+            the raw difference is already in split-finding space (f32
+            serial; exact int32 + in-scan rescale when quantized). The
+            per-slot masks are computed ONCE on the 2W lattice and
+            sliced, so bynode/interaction draws match the legacy path
+            bit-for-bit."""
+            nsh = {}
+            fmask2w, _ = slot_masks_and_bins(
+                mid_state.get("used_feat"), slots2w_c, keyr)
+            lo2w = jnp.take(leaf_lo, slots2w_c) if use_mono else None
+            hi2w = jnp.take(leaf_hi, slots2w_c) if use_mono else None
+            po2w = jnp.take(t.node_value, jnp.take(t.leaf2node, slots2w_c))
+            if not hist_sub:
+                bs, _ = fused_call(slots2w, fmask2w, depth2w, lo2w, hi2w,
+                                   po2w, row_leaf, emit_hist=False)
+                return bs, nsh
+            rlc_n = jnp.where(row_leaf < 0, DUMMY_LEAF, row_leaf)
+            raw_cnt = jax.ops.segment_sum(
+                jnp.ones((R,), jnp.int32), rlc_n, num_segments=L + 1)
+            l_raw = jnp.take(raw_cnt, jnp.clip(sel_s, 0, L))
+            r_raw = jnp.take(raw_cnt, jnp.clip(right_slot, 0, L))
+            small_is_left = l_raw <= r_raw
+            small_slots = jnp.where(
+                valid, jnp.where(small_is_left, sel_s, right_slot), -2)
+            idx_small = jnp.where(small_is_left, iw, W + iw)
+            idx_big = jnp.where(small_is_left, W + iw, iw)
+
+            def _lane(a, idx):
+                return None if a is None else jnp.take(a, idx, axis=0)
+
+            # compacted small-child stream (same lut/cumsum pass as the
+            # legacy hist_compact path)
+            is_small = jnp.zeros((L + 2,), bool).at[
+                jnp.clip(small_slots, -1, L) + 1].set(True) \
+                .at[0].set(False)
+            m = jnp.take(is_small, jnp.clip(row_leaf, -1, L) + 1)
+            pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+            n_small = m.astype(jnp.int32).sum()
+            c_idx = jnp.zeros((R,), jnp.int32).at[
+                jnp.where(m, pos, R)].set(
+                jnp.arange(R, dtype=jnp.int32), mode="drop")
+            rl_c = jnp.where(
+                jnp.arange(R, dtype=jnp.int32) < n_small,
+                jnp.take(row_leaf, c_idx), -1)
+            gh_c = jnp.take(gh, c_idx, axis=0)
+            bs_s, hsmall = fused_call(
+                small_slots, _lane(fmask2w, idx_small),
+                _lane(depth2w, idx_small), _lane(lo2w, idx_small),
+                _lane(hi2w, idx_small), _lane(po2w, idx_small),
+                rl_c, gh_in=gh_c, row_gather=c_idx, num_rows=n_small,
+                emit_hist=True)
+            parent_raw = jnp.take(st["hist_cache"],
+                                  jnp.clip(sel_s, 0, L), axis=0)
+            hbig = parent_raw - hsmall
+            sil = small_is_left.reshape((W,) + (1,) * (hsmall.ndim - 1))
+            left_raw = jnp.where(sil, hsmall, hbig)
+            right_raw = jnp.where(sil, hbig, hsmall)
+            nsh["hist_cache"] = st["hist_cache"] \
+                .at[jnp.where(valid, sel_s, DUMMY_LEAF)].set(left_raw) \
+                .at[jnp.where(valid, right_slot, DUMMY_LEAF)] \
+                .set(right_raw)
+            bs_b = find_best_splits(
+                hbig, num_bins_pf, nan_bin_pf, is_cat_pf, sp,
+                feature_mask=_lane(fmask2w, idx_big),
+                mono_type=mono_type_pf,
+                leaf_lo=_lane(lo2w, idx_big),
+                leaf_hi=_lane(hi2w, idx_big),
+                parent_output=_lane(po2w, idx_big),
+                slot_depth=_lane(depth2w, idx_big),
+                quant_scales=quant_scales)
+
+            def _mix(ks, kb):
+                s_ = small_is_left.reshape((W,) + (1,) * (ks.ndim - 1))
+                return jnp.concatenate([jnp.where(s_, ks, kb),
+                                        jnp.where(s_, kb, ks)])
+            bs = {k: _mix(bs_s[k], bs_b[k]) for k in bs_b}
+            return bs, nsh
+
     # ---------------- state ----------------
     tree = TreeArrays(
         split_feature=jnp.full((MAXN + 1,), -1, jnp.int32),
@@ -1029,16 +1162,50 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         part0 = (perm0, lb0, lc0)
         state["perm"], state["leaf_begin"], state["leaf_cnt"] = part0
     root_slots = jnp.full((2 * W,), -2, jnp.int32).at[0].set(0)
-    hraw0 = hist_raw_for(root_slots, row_leaf0, part=part0)
-    hist0 = hist_finish(hraw0)
-    if hist_sub:
-        # per-leaf RAW histogram cache (HistogramPool analog): slot i
-        # holds leaf i's histogram as of its creation; rows of a leaf
-        # only change when IT is split, so entries stay valid until
-        # popped, when the entry is the subtraction minuend
-        state["hist_cache"] = jnp.zeros(
-            (L + 1,) + hraw0.shape[1:], hraw0.dtype).at[0].set(hraw0[0])
-    root_sums = hist0[0, 0, :, :].sum(axis=0)       # all rows land in f0 bins
+    key0 = (jax.random.fold_in(rng_key, 0) if rng_key is not None else None)
+    # path smoothing makes the root split depend on the root OUTPUT
+    # (parent_output), which the fused single launch cannot know yet —
+    # smooth roots keep the two-pass flow (the loop stays fused: there
+    # the parent output is already in the tree)
+    fused_root = use_fused and not use_smooth and root_hist is None
+    bs0 = None
+    if fused_root:
+        # one VMEM-resident pass: root histogram (emitted only when the
+        # subtraction cache needs seeding) AND its best split
+        fmask0, _ = slot_masks_and_bins(state.get("used_feat"),
+                                        root_slots.clip(0), key0)
+        lo0 = (jnp.take(state["leaf_lo"], root_slots.clip(0))
+               if use_mono else None)
+        hi0 = (jnp.take(state["leaf_hi"], root_slots.clip(0))
+               if use_mono else None)
+        bs0, hraw0 = fused_call(
+            root_slots, fmask0, jnp.zeros((2 * W,), jnp.int32), lo0, hi0,
+            None, row_leaf0, emit_hist=hist_sub)
+    elif root_hist is not None:
+        # class-batched root dedupe (ISSUE 14 satellite): the K classes'
+        # root histograms were built pre-vmap by ONE kernel streaming
+        # the bins block once; non-root lattice slots are exact zeros in
+        # both formulations (no row carries the -2 sentinel)
+        hraw0 = jnp.zeros((2 * W,) + root_hist.shape,
+                          root_hist.dtype).at[0].set(root_hist)
+    else:
+        hraw0 = hist_raw_for(root_slots, row_leaf0, part=part0)
+    if fused_root and not hist_sub:
+        # pure fused mode: the root histogram never exists — totals come
+        # from the kernel's per-slot totals record (sum-then-rescale; in
+        # float this can differ from the two-pass scale-then-sum in the
+        # last bits, documented in the fused kernel contract)
+        root_sums = bs0["slot_totals"][0]
+    else:
+        hist0 = hist_finish(hraw0)
+        if hist_sub:
+            # per-leaf RAW histogram cache (HistogramPool analog): slot i
+            # holds leaf i's histogram as of its creation; rows of a leaf
+            # only change when IT is split, so entries stay valid until
+            # popped, when the entry is the subtraction minuend
+            state["hist_cache"] = jnp.zeros(
+                (L + 1,) + hraw0.shape[1:], hraw0.dtype).at[0].set(hraw0[0])
+        root_sums = hist0[0, 0, :, :].sum(axis=0)   # all rows land in f0 bins
     if mode == "voting":
         # local hist -> global root sums (the Allreduce of root
         # (count, sum_g, sum_h), data_parallel_tree_learner.cpp:160-219)
@@ -1065,9 +1232,10 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         leaf_values=tree.leaf_values.at[0].set(root_val),
     )
     slot_valid0 = jnp.zeros((2 * W,), bool).at[0].set(True)
-    key0 = (jax.random.fold_in(rng_key, 0) if rng_key is not None else None)
-    bs0 = best_for(hist0, jnp.zeros((2 * W,), jnp.int32), slot_valid0,
-                   root_slots.clip(0), tree, state, key0, rl=row_leaf0)
+    if bs0 is None:
+        bs0 = best_for(hist0, jnp.zeros((2 * W,), jnp.int32), slot_valid0,
+                       root_slots.clip(0), tree, state, key0,
+                       rl=row_leaf0)
     bs_gain = bs_gain.at[0].set(bs0["gain"][0])
     bs_feat = bs_feat.at[0].set(bs0["feature"][0])
     bs_thr = bs_thr.at[0].set(bs0["threshold"][0])
@@ -1548,7 +1716,25 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
         slots2w = jnp.concatenate([jnp.where(valid, sel_s, -2),
                                    jnp.where(valid, right_slot, -2)])
         new_state_hist = {}
-        if hist_sub:
+        slots2w_c = jnp.where(slots2w >= 0, slots2w, DUMMY_LEAF)
+        depth2w = jnp.take(leaf_depth,
+                           jnp.concatenate([sel_s, right_slot]))
+        keyr = (jax.random.fold_in(rng_key, st["r"] + 1)
+                if rng_key is not None else None)
+        mid_state = dict(leaf_lo=leaf_lo, leaf_hi=leaf_hi,
+                         **new_state_extra, **new_state_mono)
+        valid2w = jnp.concatenate([valid, valid])
+        if use_fused:
+            bs, nsh = fused_children(
+                st, t, row_leaf, sel_s, right_slot, valid, slots2w,
+                slots2w_c, depth2w, mid_state, keyr, leaf_lo, leaf_hi)
+            new_state_hist.update(nsh)
+            # same gain gating best_for applies after its lattice scan
+            g = bs["gain"]
+            if max_depth > 0:
+                g = jnp.where(depth2w < max_depth, g, NEG_INF)
+            bs["gain"] = jnp.where(valid2w, g, NEG_INF)
+        elif hist_sub:
             if use_native_part:
                 raw_cnt = lc_n          # partition maintains the counts
             else:
@@ -1602,15 +1788,9 @@ def _build_tree_impl(bins: jax.Array, gh: jax.Array, row_leaf0: jax.Array,
             hist2w = hist_finish(jnp.concatenate([left_raw, right_raw]))
         else:
             hist2w = hist_for(slots2w, row_leaf, part=part_n)
-        depth2w = jnp.take(leaf_depth,
-                           jnp.concatenate([sel_s, right_slot]))
-        keyr = (jax.random.fold_in(rng_key, st["r"] + 1)
-                if rng_key is not None else None)
-        mid_state = dict(leaf_lo=leaf_lo, leaf_hi=leaf_hi,
-                         **new_state_extra, **new_state_mono)
-        slots2w_c = jnp.where(slots2w >= 0, slots2w, DUMMY_LEAF)
-        bs = best_for(hist2w, depth2w, jnp.concatenate([valid, valid]),
-                      slots2w_c, t, mid_state, keyr, rl=row_leaf)
+        if not use_fused:
+            bs = best_for(hist2w, depth2w, valid2w,
+                          slots2w_c, t, mid_state, keyr, rl=row_leaf)
 
         scatter_slots = slots2w_c
         bs_gain = st["bs_gain"].at[scatter_slots].set(bs["gain"]) \
@@ -1652,7 +1832,7 @@ _build_tree_jit = functools.partial(
                      "block_rows", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins", "mono_method",
                      "forced", "hist_sub", "feature_sharded",
-                     "hist_merge", "n_shards"))(
+                     "hist_merge", "n_shards", "fused_split"))(
     _build_tree_impl)
 
 
@@ -1708,17 +1888,36 @@ def _build_tree_class_batched(bins, gh, row_leaf0, num_bins_pf,
     if hist_impl == "native":
         hist_impl = "scatter"
 
-    def one(gh_k, key_k, qs_k):
+    # Class-batched root dedupe (ISSUE 14 satellite): vmapping the core
+    # makes each class's ROOT histogram launch re-stream the bins block
+    # — K reads of the widest operand for K identical one-hot encodings.
+    # On the Pallas serial path, build the K root histograms pre-vmap
+    # with ONE kernel whose MXU N-dim is the class axis (bins read once)
+    # and hand each class its slice via the builder's ``root_hist``
+    # seam. Gated to plans where the root build is a plain single-device
+    # Pallas launch (no EFB bundling, no mesh merge, no feature shard).
+    root_hist = None
+    if (hist_impl == "pallas" and kw.get("axis_name") is None
+            and kw.get("bundle_meta") is None
+            and kw.get("local_bins") is None
+            and not kw.get("feature_sharded", False)):
+        root_hist = PH.build_root_histograms_classes(
+            bins, gh, row_leaf0, num_bins=kw["num_bins"],
+            hist_dtype=kw.get("hist_dtype", "bfloat16"))
+
+    def one(gh_k, key_k, qs_k, rh_k):
         return _build_tree_impl(bins, gh_k, row_leaf0, num_bins_pf,
                                 nan_bin_pf, is_cat_pf, feature_mask,
                                 rng_key=key_k, quant_scales=qs_k,
-                                hist_impl=hist_impl, **kw)
+                                hist_impl=hist_impl, root_hist=rh_k,
+                                **kw)
 
     return jax.vmap(
         one, in_axes=(0,
                       None if rng_key is None else 0,
-                      None if quant_scales is None else 0))(
-        gh, rng_key, quant_scales)
+                      None if quant_scales is None else 0,
+                      None if root_hist is None else 0))(
+        gh, rng_key, quant_scales, root_hist)
 
 
 _build_tree_cb_jit = functools.partial(
@@ -1728,5 +1927,5 @@ _build_tree_cb_jit = functools.partial(
                      "block_rows", "feature_fraction_bynode",
                      "parallel_mode", "top_k", "bundle_bins", "mono_method",
                      "forced", "hist_sub", "feature_sharded",
-                     "hist_merge", "n_shards"))(
+                     "hist_merge", "n_shards", "fused_split"))(
     _build_tree_class_batched)

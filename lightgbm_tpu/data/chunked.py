@@ -30,10 +30,15 @@ path with ``hist_subtraction=false`` and the same pinned ``hist_impl``
 
 Scope: the chunked path deliberately supports the SERIAL simple-branch
 feature set (bagging/GOSS, quantized gradients, categoricals,
-feature_fraction, gain_scale, valid-set tracking). Histogram
-subtraction is simply not used — every round builds the split
-children's histograms in full from the stream (the parent cache it
-would subtract from is exactly the state a chunked sweep cannot keep).
+feature_fraction, gain_scale, valid-set tracking). With
+``hist_subtraction`` on (the default) each round streams only the W
+SMALLER siblings and derives the big ones from a per-leaf RAW parent
+cache by subtraction ([L+1, F, B, 3] device state — tiny next to the
+[R, F] matrix chunking exists to avoid; exact in int32 quantized mode,
+f32 subtraction rounding otherwise — the resident builder's own
+hist_sub caveat). ``hist_subtraction=false`` restores the full
+per-round rebuild, which is what the resident-vs-chunked bitwise
+parity tests pin.
 Everything that bends the round body — EFB bundles, linear trees,
 CEGB, forced splits, monotone constraints, interaction constraints,
 per-node sampling, extra-trees, meshes — gates back to resident in
@@ -137,7 +142,8 @@ class ChunkedTreeBuilder:
                  num_bins: int, split_params: SplitParams,
                  hist_dtype: str = "bfloat16", hist_impl: str = "auto",
                  block_rows: int = 0,
-                 cat_sorted_mask: Optional[jax.Array] = None):
+                 cat_sorted_mask: Optional[jax.Array] = None,
+                 hist_sub: bool = True):
         impl = resolve_impl(hist_impl)
         if impl not in ("scatter", "matmul"):
             # native/pallas have no carried-init formulation that is
@@ -162,11 +168,22 @@ class ChunkedTreeBuilder:
         self.BW = (self.B + 31) // 32
         from ..boosting.tree_builder import max_rounds_for
         self.rounds_bound = max_rounds_for(self.L, self.W)
+        # parent-minus-child subtraction (serial_tree_learner.cpp:567
+        # Subtract analog, ROADMAP item 2 leftover): keep a per-leaf RAW
+        # parent histogram cache across rounds so each sweep streams
+        # only the W SMALLER siblings' histograms and derives the big
+        # ones by subtraction — the cache is [L+1, F, B, 3] device
+        # state, tiny next to the [R, F] matrix chunking exists to
+        # avoid. Exact (bit-identical to the full rebuild) in int32
+        # quantized mode; f32 differs by subtraction rounding, the same
+        # accepted variance as the resident builder's hist_sub path.
+        self.hist_sub = bool(hist_sub)
 
         self._pop_j = jax.jit(self._pop_impl)
         self._chunk_j = jax.jit(self._chunk_impl)
         self._root_j = jax.jit(self._root_impl)
         self._finish_j = jax.jit(self._finish_impl)
+        self._sub_j = jax.jit(self._sub_impl)
 
     # -------------------------- shared pieces -------------------------
 
@@ -408,8 +425,37 @@ class ChunkedTreeBuilder:
         depth2w = jnp.take(leaf_depth,
                            jnp.concatenate([sel_s, right_slot]))
         valid2w = jnp.concatenate([valid, valid])
+        # subtraction mode sweeps only the smaller child of each split:
+        # the cached split sums carry the exact per-child count channel
+        # (integers in f32; the quantized count scale is 1), so the
+        # choice is made before any chunk is streamed
+        small_is_left = slsum[:, 2] <= srsum[:, 2]
+        small_slots = jnp.where(
+            valid, jnp.where(small_is_left, sel_s, right_slot), -2)
         return (t, leaf_depth, pend, slots2w, slots2w_c, depth2w,
-                valid2w, valid_row_leaf)
+                valid2w, small_slots, small_is_left, valid_row_leaf)
+
+    def _sub_impl(self, acc_small, hist_cache, slots2w, small_is_left):
+        """Assemble the round's full [2W, F, B, 3] RAW lattice from the
+        W swept smaller children + the per-leaf parent cache (big =
+        parent - small), and roll the cache forward to the children.
+        Mirrors the resident builder's fused_children/hist_sub scatter:
+        invalid lanes park their writes on the DUMMY_LEAF row."""
+        W = self.W
+        sel_s = slots2w[:W]
+        right_slot = slots2w[W:]
+        valid = sel_s >= 0
+        parent_raw = jnp.take(hist_cache, jnp.clip(sel_s, 0, self.L),
+                              axis=0)
+        hbig = parent_raw - acc_small
+        sil = small_is_left.reshape((W,) + (1,) * (acc_small.ndim - 1))
+        left_raw = jnp.where(sil, acc_small, hbig)
+        right_raw = jnp.where(sil, hbig, acc_small)
+        hist_cache = hist_cache \
+            .at[jnp.where(valid, sel_s, self.DUMMY_LEAF)].set(left_raw) \
+            .at[jnp.where(valid, right_slot, self.DUMMY_LEAF)] \
+            .set(right_raw)
+        return jnp.concatenate([left_raw, right_raw]), hist_cache
 
     def _finish_impl(self, acc, tree, caches, slots2w_c, depth2w,
                      valid2w, feature_mask, quant_scales, gain_scale):
@@ -438,7 +484,8 @@ class ChunkedTreeBuilder:
     # -------------------------- eager driver --------------------------
 
     def _sweep(self, pref, row_leaf, gh, slots, pend, acc_dt):
-        acc = jnp.zeros((2 * self.W, self.F, self.B, HIST_CH), acc_dt)
+        S = int(slots.shape[0])
+        acc = jnp.zeros((S, self.F, self.B, HIST_CH), acc_dt)
         for off, dev_bins in pref.chunks():
             row_leaf, acc = self._chunk_j(dev_bins, row_leaf, gh, acc,
                                           off, slots, pend)
@@ -480,13 +527,27 @@ class ChunkedTreeBuilder:
                                      self._zero_pend(), acc_dt)
         tree, caches, more = self._root_j(acc0, tree, feature_mask,
                                           quant_scales, gain_scale)
+        hist_cache = None
+        if self.hist_sub:
+            hist_cache = jnp.zeros(
+                (self.L + 1,) + acc0.shape[1:], acc_dt).at[0].set(acc0[0])
         r = 0
         while r < self.rounds_bound and bool(more):
             (tree, leaf_depth, pend, slots2w, slots2w_c, depth2w,
-             valid2w, vrl) = self._pop_j(tree, caches, leaf_depth,
-                                         vbins, vrl)
-            row_leaf, acc = self._sweep(pref, row_leaf, gh, slots2w,
-                                        pend, acc_dt)
+             valid2w, small_slots, small_is_left,
+             vrl) = self._pop_j(tree, caches, leaf_depth, vbins, vrl)
+            if self.hist_sub:
+                # stream only the W smaller siblings; the big ones come
+                # from the parent cache by subtraction — halves the
+                # sweep's histogram lattice and skips the larger
+                # child's bin traffic entirely
+                row_leaf, acc_s = self._sweep(pref, row_leaf, gh,
+                                              small_slots, pend, acc_dt)
+                acc, hist_cache = self._sub_j(acc_s, hist_cache,
+                                              slots2w, small_is_left)
+            else:
+                row_leaf, acc = self._sweep(pref, row_leaf, gh, slots2w,
+                                            pend, acc_dt)
             caches, more = self._finish_j(acc, tree, caches, slots2w_c,
                                           depth2w, valid2w,
                                           feature_mask, quant_scales,

@@ -139,9 +139,14 @@ _PALLAS_TRAIN_OK: Optional[bool] = None
 
 
 def _reset_pallas_probe() -> None:
-    """Forget the cached Pallas probe verdict (tests only)."""
+    """Forget the cached Pallas probe verdicts (tests only) — both the
+    training-path verdict here and the fused build+split verdict in
+    ops.pallas_histogram (they gate independently: a chip can run the
+    histogram kernel yet reject the fused epilogue)."""
     global _PALLAS_TRAIN_OK
     _PALLAS_TRAIN_OK = None
+    from . import pallas_histogram
+    pallas_histogram._FUSED_PROBE.clear()
 
 
 def _probe_pallas_training() -> bool:

@@ -44,8 +44,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .histogram import HIST_CH
+from . import split as _split
 
-__all__ = ["build_histograms_pallas", "pallas_available"]
+__all__ = ["build_histograms_pallas", "pallas_available",
+           "fused_build_best_splits", "fused_plan_ok", "fused_probe_ok",
+           "fused_candidate_bytes", "build_root_histograms_classes"]
 
 
 def pallas_available() -> bool:
@@ -309,3 +312,513 @@ def build_histograms_pallas(bins: jax.Array, gh: jax.Array,
                                               :l_pad * HIST_CH]
     hist = hist.reshape(n_fb, fc, Bp, l_pad, HIST_CH)[:, :, :B, :L, :]
     return hist.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# Fused histogram → split-find kernel (ISSUE 14 / ROADMAP item 1).
+#
+# Same accumulation grid as `_kernel`; on the LAST row step of each
+# feature chunk an epilogue runs ops/split.py's dense gain lattice
+# (`eval_split_lattice`) on the VMEM-resident accumulator and emits one
+# [l_pad, 128] candidate record block per chunk — gain, global feature,
+# bin, missing-direction, winner left/right (G, H, count), constrained
+# outputs, and the chunk's leaf totals. A tiny XLA argmax over chunks
+# (`fused_build_best_splits` postlude) then replaces the full-lattice
+# scan: the [L, F, B, 3] histogram never round-trips through HBM unless
+# the caller asks for it (`emit_hist=True`, which feeds the histogram
+# subtraction cache).
+#
+# Candidate record lanes (f32):
+#   0 gain   1 feature(global)  2 bin  3 dir(1=missing-left)
+#   4..6 left (G, H, count)     7..9 right (G, H, count)
+#   10 left_out  11 right_out   12..14 leaf totals (G, H, count)
+#
+# Quantized path: int8 gh → int32 accumulators, scanned EXACTLY in the
+# epilogue with the grid-value rescale applied at gain time
+# (`eval_split_lattice(quant_scales=...)`) — no dequantized histogram is
+# ever materialized.
+# ---------------------------------------------------------------------------
+
+_REC_LANES = 128
+
+
+def fused_plan_ok(F: int, B: int, L: int) -> bool:
+    """True when `_plan_chunks` yields a lane-aligned plan — the fused
+    epilogue reshapes the accumulator [fb_pad, lb3_pad] into
+    [fc, Bp, l_pad, 3], which is only exact when the pads compile away."""
+    _, fc, Bp, l_pad = _plan_chunks(F, B, L)
+    return (fc * Bp) % 128 == 0 and (l_pad * HIST_CH) % 128 == 0
+
+
+def fused_candidate_bytes(F: int, B: int, L: int) -> int:
+    """HBM bytes of the fused kernel's candidate-record output stream.
+
+    This is the only lattice-sized traffic the fused build pass writes:
+    one [l_pad, _REC_LANES] f32 record block per feature chunk, in place
+    of the two-pass path's [F, B, L, 3] histogram write + re-read. Used
+    by the telemetry cost model's analytical byte counts."""
+    _, fc, _, l_pad = _plan_chunks(F, B, L)
+    n_fb = -(-F // fc)
+    return n_fb * l_pad * _REC_LANES * 4
+
+
+def _split_epilogue(acc, chunk_idx, fmeta, lmeta, fmask, *, params,
+                    fc: int, Bp: int, l_pad: int, use_mono: bool,
+                    use_smooth: bool, pen_on: bool, quant: bool):
+    """Gain lattice + per-chunk argmax over the VMEM-resident accumulator.
+
+    acc:   [fc*Bp, l_pad*3] (f32, or int32 quantized)
+    fmeta: [8, fc] int32 — rows 0 num_bins_pf, 1 nan_bin, 2 is_cat,
+           3 mono_type (this chunk's feature slice)
+    lmeta: [8, l_pad] f32 — rows 0 parent_output, 1 leaf_lo, 2 leaf_hi,
+           3 mono_pen, 4 g_scale, 5 h_scale
+    fmask: [l_pad, fc] int32 candidate-feature mask
+    Returns the [l_pad, _REC_LANES] candidate record block.
+    """
+    hist = acc.reshape(fc, Bp, l_pad, HIST_CH).transpose(2, 0, 1, 3)
+    lat = _split.eval_split_lattice(
+        hist, fmeta[0], fmeta[1], fmeta[2] != 0, params,
+        feature_mask=(fmask != 0),
+        mono_type=fmeta[3] if use_mono else None,
+        leaf_lo=lmeta[1] if use_mono else None,
+        leaf_hi=lmeta[2] if use_mono else None,
+        parent_output=lmeta[0] if use_smooth else None,
+        mono_pen=lmeta[3] if pen_on else None,
+        quant_scales=(jnp.stack([lmeta[4], lmeta[5]], axis=1)
+                      if quant else None))
+    N = fc * Bp * 2
+    flat = lat["net"].reshape(l_pad, N)
+    best = jnp.argmax(flat, axis=1)
+    # gather-free winner select (Mosaic rejects lax.gather): one-hot the
+    # argmax and reduce. where() keeps -inf/0 products out of the sum.
+    sel = (jax.lax.broadcasted_iota(jnp.int32, (l_pad, N), 1)
+           == best[:, None])
+
+    def pick1(a):
+        return jnp.sum(jnp.where(sel, a.reshape(l_pad, N), 0.0), axis=1)
+
+    def pick3(a):
+        return jnp.sum(jnp.where(sel[:, :, None], a.reshape(l_pad, N, 3),
+                                 0.0), axis=1)
+
+    gain = pick1(flat)
+    lsum = pick3(lat["left"])
+    rsum = pick3(lat["right"])
+    f_loc = (best // (Bp * 2)).astype(jnp.int32)
+    feat_g = chunk_idx * fc + f_loc
+    thr = ((best // 2) % Bp).astype(jnp.int32)
+    opt = (best % 2).astype(jnp.int32)
+    tot0 = lat["totals"][:, 0, :]          # any feature's totals = leaf's
+    rec = jnp.stack([
+        gain, feat_g.astype(jnp.float32), thr.astype(jnp.float32),
+        opt.astype(jnp.float32),
+        lsum[:, 0], lsum[:, 1], lsum[:, 2],
+        rsum[:, 0], rsum[:, 1], rsum[:, 2],
+        pick1(lat["out_l"]), pick1(lat["out_r"]),
+        tot0[:, 0], tot0[:, 1], tot0[:, 2],
+    ], axis=1)                              # [l_pad, 15]
+    return jnp.pad(rec, ((0, 0), (0, _REC_LANES - rec.shape[1])))
+
+
+def _fused_kernel(bins_ref, gh_ref, leaf_ref, lids_ref, fmeta_ref,
+                  lmeta_ref, fmask_ref, *refs, num_bins: int, cdt,
+                  fb_pad: int, lb3_pad: int, acc_dt, n_rb: int,
+                  emit_hist: bool, params, fc: int, Bp: int, l_pad: int,
+                  use_mono: bool, use_smooth: bool, pen_on: bool,
+                  quant: bool, nr_ref=None, blk_rows: int = 0):
+    """Accumulation grid step + last-row-step split epilogue.
+
+    Output refs: emit_hist → (hist_out, cand_out) with the histogram
+    block doubling as the accumulator; else (cand_out, acc_scratch) with
+    the accumulator in VMEM scratch — the histogram never leaves the
+    chip."""
+    if emit_hist:
+        acc_ref, cand_ref = refs
+    else:
+        cand_ref, acc_ref = refs
+    _kernel(bins_ref, gh_ref, leaf_ref, lids_ref, acc_ref,
+            num_bins=num_bins, cdt=cdt, fb_pad=fb_pad, lb3_pad=lb3_pad,
+            acc_dt=acc_dt, nr_ref=nr_ref, blk_rows=blk_rows)
+    # program_id must be read at kernel top level (inside a pl.when body
+    # it misses the interpret-mode grid-env substitution)
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == n_rb - 1)
+    def _():
+        cand_ref[:] = _split_epilogue(
+            acc_ref[:], i, fmeta_ref[:], lmeta_ref[:],
+            fmask_ref[:], params=params, fc=fc, Bp=Bp, l_pad=l_pad,
+            use_mono=use_mono, use_smooth=use_smooth, pen_on=pen_on,
+            quant=quant)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "params", "hist_dtype", "interpret",
+                     "emit_hist"))
+def fused_build_best_splits(bins: jax.Array, gh: jax.Array,
+                            row_leaf: jax.Array, leaf_ids: jax.Array, *,
+                            num_bins: int, params,
+                            num_bins_pf: jax.Array, nan_bin_pf: jax.Array,
+                            is_cat_pf: jax.Array,
+                            feature_mask: Optional[jax.Array] = None,
+                            mono_type: Optional[jax.Array] = None,
+                            leaf_lo: Optional[jax.Array] = None,
+                            leaf_hi: Optional[jax.Array] = None,
+                            parent_output: Optional[jax.Array] = None,
+                            mono_pen: Optional[jax.Array] = None,
+                            quant_scales: Optional[jax.Array] = None,
+                            hist_dtype: str = "bfloat16",
+                            interpret: bool = False,
+                            num_rows: Optional[jax.Array] = None,
+                            emit_hist: bool = False):
+    """One VMEM-resident pass: build histograms AND find best splits.
+
+    Contract mirrors `build_histograms_pallas` for the row-stream
+    operands plus `ops.split.find_best_splits` for the metadata; returns
+    ``(best, hist)`` where ``best`` is the find_best_splits dict (gain,
+    feature, threshold, default_left, left_sum, right_sum, left_out,
+    right_out, is_cat_split, cat_bitset — plus "slot_totals" [L, 3], the
+    per-leaf (G, H, count) totals for root-sum bootstrapping) and
+    ``hist`` is the [L, F, B, 3] histogram when ``emit_hist=True``
+    (feeds the subtraction cache) or ``None`` (pure mode — the histogram
+    never touches HBM; only [n_chunks * l_pad, 128] candidate records do).
+
+    Winners are bit-equal to ``find_best_splits`` over the scatter-path
+    histogram: the epilogue runs the identical `eval_split_lattice` ops
+    on the identical accumulator block, per-chunk/within-chunk first-max
+    argmaxes compose to the same global first-max tie-break, and the
+    postlude's cross-chunk argmax runs over feature-contiguous chunks.
+
+    Gates the caller must respect (`find_best_splits` fallback):
+    sorted-subset categoricals, extra-trees random thresholds,
+    gain scale/penalty (feature_contri, CEGB), advanced monotone bounds,
+    and unaligned chunk plans (check `fused_plan_ok`).
+    """
+    if not _HAS_PALLAS:
+        raise RuntimeError("pallas unavailable in this jax build")
+    R, F = bins.shape
+    L = int(leaf_ids.shape[0])
+    B = int(num_bins)
+    quant = gh.dtype == jnp.int8
+    if quant and quant_scales is None:
+        raise ValueError("int8 gh requires quant_scales")
+    cdt = jnp.int8 if quant else jnp.dtype(hist_dtype)
+    acc_dt = jnp.int32 if quant else jnp.float32
+    blk, fc, Bp, l_pad = _plan_chunks(F, B, L)
+    fb_pad = -(-(fc * Bp) // 128) * 128
+    lb3_pad = -(-(l_pad * HIST_CH) // 128) * 128
+    if fb_pad != fc * Bp or lb3_pad != l_pad * HIST_CH:
+        raise ValueError(
+            "fused split kernel needs an aligned chunk plan "
+            f"(F={F}, B={B}, L={L}); gate on fused_plan_ok() first")
+
+    r_pad = ((R + blk - 1) // blk) * blk
+    if r_pad != R:
+        bins = jnp.pad(bins, ((0, r_pad - R), (0, 0)))
+        gh = jnp.pad(gh, ((0, r_pad - R), (0, 0)))
+        row_leaf = jnp.pad(row_leaf, (0, r_pad - R), constant_values=-1)
+    n_fb = F // fc
+    n_rb = r_pad // blk
+
+    gh8 = jnp.pad(gh, ((0, 0), (0, 8 - HIST_CH)))
+    leaf8 = jnp.broadcast_to(row_leaf[:, None].astype(jnp.int32),
+                             (r_pad, 8))
+    lids8 = jnp.broadcast_to(
+        jnp.pad(leaf_ids.astype(jnp.int32), (0, l_pad - L),
+                constant_values=-2)[None, :], (8, l_pad))
+
+    use_mono = mono_type is not None
+    use_smooth = params.path_smooth > 0.0
+    pen_on = use_mono and params.monotone_penalty > 0.0
+
+    zi = jnp.zeros((F,), jnp.int32)
+    fmeta = jnp.stack([
+        num_bins_pf.astype(jnp.int32), nan_bin_pf.astype(jnp.int32),
+        is_cat_pf.astype(jnp.int32),
+        mono_type.astype(jnp.int32) if use_mono else zi,
+        zi, zi, zi, zi], axis=0)                          # [8, F]
+
+    zf = jnp.zeros((l_pad,), jnp.float32)
+
+    def _lrow(a, fill=0.0):
+        if a is None:
+            return zf
+        return jnp.pad(a.astype(jnp.float32), (0, l_pad - L),
+                       constant_values=fill)
+
+    if quant:
+        qsf = quant_scales.astype(jnp.float32)
+        srow_g = jnp.broadcast_to(qsf[0], (l_pad,))
+        srow_h = jnp.broadcast_to(qsf[1], (l_pad,))
+    else:
+        srow_g = srow_h = zf
+    lmeta = jnp.stack([
+        _lrow(parent_output), _lrow(leaf_lo), _lrow(leaf_hi),
+        _lrow(mono_pen, fill=1.0), srow_g, srow_h, zf, zf],
+        axis=0)                                           # [8, l_pad]
+
+    if feature_mask is None:
+        fmask = jnp.ones((l_pad, F), jnp.int32)
+    else:
+        fm2 = (feature_mask if feature_mask.ndim == 2
+               else jnp.broadcast_to(feature_mask[None, :], (L, F)))
+        fmask = jnp.pad(fm2.astype(jnp.int32), ((0, l_pad - L), (0, 0)),
+                        constant_values=1)
+
+    kern = functools.partial(
+        _fused_kernel, num_bins=Bp, cdt=cdt, fb_pad=fb_pad,
+        lb3_pad=lb3_pad, acc_dt=acc_dt, n_rb=n_rb, emit_hist=emit_hist,
+        params=params, fc=fc, Bp=Bp, l_pad=l_pad, use_mono=use_mono,
+        use_smooth=use_smooth, pen_on=pen_on, quant=quant)
+
+    cand_shape = jax.ShapeDtypeStruct((n_fb * l_pad, _REC_LANES),
+                                      jnp.float32)
+    hist_shape = jax.ShapeDtypeStruct((n_fb * fb_pad, lb3_pad), acc_dt)
+    if emit_hist:
+        out_shape = (hist_shape, cand_shape)
+        scratch = []
+    else:
+        out_shape = (cand_shape,)
+        scratch = [pltpu.VMEM((fb_pad, lb3_pad), acc_dt)]
+    operands = (bins.astype(jnp.int32), gh8, leaf8, lids8, fmeta, lmeta,
+                fmask)
+
+    if num_rows is None:
+        def _specs(w):
+            row = [
+                pl.BlockSpec((blk, fc), lambda i, j: (j, i)),
+                pl.BlockSpec((blk, 8), lambda i, j: (j, 0)),
+                pl.BlockSpec((blk, 8), lambda i, j: (j, 0)),
+            ]
+            meta = [
+                pl.BlockSpec((8, l_pad), lambda i, j: (0, 0)),
+                pl.BlockSpec((8, fc), lambda i, j: (0, i)),
+                pl.BlockSpec((8, l_pad), lambda i, j: (0, 0)),
+                pl.BlockSpec((l_pad, fc), lambda i, j: (0, i)),
+            ]
+            hist_o = [pl.BlockSpec((fb_pad, lb3_pad), lambda i, j: (i, 0))]
+            cand_o = [pl.BlockSpec((l_pad, _REC_LANES),
+                                   lambda i, j: (i, 0))]
+            return row + meta, (hist_o + cand_o if w else cand_o)
+
+        in_specs, out_specs = _specs(emit_hist)
+        outs = pl.pallas_call(
+            kern,
+            grid=(n_fb, n_rb),
+            in_specs=in_specs,
+            out_specs=tuple(out_specs) if emit_hist else out_specs[0],
+            out_shape=out_shape if emit_hist else out_shape[0],
+            scratch_shapes=scratch,
+            compiler_params=_compiler_params(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(*operands)
+    else:
+        nr = jnp.reshape(jnp.asarray(num_rows, jnp.int32), (1,))
+
+        def _row_clamp(s, j):
+            jmax = jnp.maximum((s[0] + blk - 1) // blk - 1, 0)
+            return jnp.minimum(j, jmax)
+
+        def kern_nr(s_ref, *refs):
+            kern(*refs, nr_ref=s_ref, blk_rows=blk)
+
+        in_specs = [
+            pl.BlockSpec((blk, fc), lambda i, j, s: (_row_clamp(s, j), i)),
+            pl.BlockSpec((blk, 8), lambda i, j, s: (_row_clamp(s, j), 0)),
+            pl.BlockSpec((blk, 8), lambda i, j, s: (_row_clamp(s, j), 0)),
+            pl.BlockSpec((8, l_pad), lambda i, j, s: (0, 0)),
+            pl.BlockSpec((8, fc), lambda i, j, s: (0, i)),
+            pl.BlockSpec((8, l_pad), lambda i, j, s: (0, 0)),
+            pl.BlockSpec((l_pad, fc), lambda i, j, s: (0, i)),
+        ]
+        hist_o = pl.BlockSpec((fb_pad, lb3_pad), lambda i, j, s: (i, 0))
+        cand_o = pl.BlockSpec((l_pad, _REC_LANES), lambda i, j, s: (i, 0))
+        outs = pl.pallas_call(
+            kern_nr,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n_fb, n_rb),
+                in_specs=in_specs,
+                out_specs=((hist_o, cand_o) if emit_hist else cand_o),
+                scratch_shapes=tuple(scratch),
+            ),
+            out_shape=out_shape if emit_hist else out_shape[0],
+            compiler_params=_compiler_params(
+                dimension_semantics=("parallel", "arbitrary")),
+            interpret=interpret,
+        )(nr, *operands)
+
+    if emit_hist:
+        hist_raw, cand = outs
+        hist = hist_raw.reshape(n_fb, fb_pad, lb3_pad)
+        hist = hist.reshape(n_fb, fc, Bp, l_pad, HIST_CH)[:, :, :B, :L, :]
+        hist = hist.reshape(F, B, L, HIST_CH).transpose(2, 0, 1, 3)
+    else:
+        hist, cand = None, outs
+
+    # ---- XLA postlude: tiny argmax over chunks replaces the full scan
+    cand = cand.reshape(n_fb, l_pad, _REC_LANES)[:, :L, :]
+    bc = jnp.argmax(cand[:, :, 0], axis=0)                # [L] first-max
+    rec = jnp.take_along_axis(cand, bc[None, :, None], axis=0)[0]
+    gain = rec[:, 0]
+    feat = rec[:, 1].astype(jnp.int32)
+    thr = rec[:, 2].astype(jnp.int32)
+    is_cat_split = jnp.take(is_cat_pf.astype(bool), feat)
+    member = ((jnp.arange(B, dtype=jnp.int32)[None, :] == thr[:, None])
+              & is_cat_split[:, None] & jnp.isfinite(gain)[:, None])
+    best = {
+        "gain": gain,
+        "feature": feat,
+        "threshold": thr,
+        "default_left": rec[:, 3] == 1.0,
+        "left_sum": rec[:, 4:7],
+        "right_sum": rec[:, 7:10],
+        "left_out": rec[:, 10],
+        "right_out": rec[:, 11],
+        "is_cat_split": is_cat_split,
+        "cat_bitset": _split.pack_member_bitset(member),
+        "slot_totals": rec[:, 12:15],
+    }
+    return best, hist
+
+
+_FUSED_PROBE: dict = {}
+
+
+def fused_probe_ok() -> bool:
+    """One-time compile-and-run probe of the fused kernel on the real
+    backend (mirrors ops.histogram's pallas training probe); always True
+    caching aside. CPU/interpret callers skip this (fused_split="on")."""
+    if "ok" in _FUSED_PROBE:
+        return _FUSED_PROBE["ok"]
+    if not pallas_available():
+        _FUSED_PROBE["ok"] = False
+        return False
+    try:
+        F, B, L, R = 16, 8, 4, 256
+        bins = jnp.zeros((R, F), jnp.int32)
+        gh = jnp.ones((R, HIST_CH), jnp.float32)
+        rl = jnp.zeros((R,), jnp.int32)
+        best, _ = fused_build_best_splits(
+            bins, gh, rl, jnp.arange(L, dtype=jnp.int32), num_bins=B,
+            params=_split.SplitParams(),
+            num_bins_pf=jnp.full((F,), B, jnp.int32),
+            nan_bin_pf=jnp.full((F,), -1, jnp.int32),
+            is_cat_pf=jnp.zeros((F,), bool))
+        jax.block_until_ready(best["gain"])
+        _FUSED_PROBE["ok"] = True
+    except Exception:  # pragma: no cover - only on real hardware quirks
+        _FUSED_PROBE["ok"] = False
+    return _FUSED_PROBE["ok"]
+
+
+def _reset_fused_probe():
+    _FUSED_PROBE.clear()
+
+
+# ---------------------------------------------------------------------------
+# Class-shared root histogram (ISSUE-14 satellite): the class-batched
+# multiclass build vmaps the whole tree build, which batches EVERY
+# pallas operand — the bins matrix, logically shared across classes, is
+# presented K× to the root launch. This kernel instead streams bins ONCE
+# and reduces all K classes' (g, h, count) lanes against the same
+# one-hot: ghl is [blk, K*3] with the root-leaf row mask applied
+# elementwise, so the MXU emits [fc*Bp, K*3] per chunk.
+# ---------------------------------------------------------------------------
+
+
+def _class_kernel(bins_ref, ghk_ref, leaf_ref, out_ref, *, num_bins: int,
+                  cdt, fb_pad: int, kc_pad: int, acc_dt,
+                  root_slot: int):
+    j = pl.program_id(1)
+    blk, fc = bins_ref.shape
+
+    def compute():
+        bb = bins_ref[:]
+        iota_b = jax.lax.broadcasted_iota(
+            jnp.int32, (blk, fc, num_bins), 2)
+        onehot = (bb[:, :, None] == iota_b).astype(cdt).reshape(
+            blk, fc * num_bins)
+        if fb_pad != fc * num_bins:
+            onehot = jnp.pad(onehot,
+                             ((0, 0), (0, fb_pad - fc * num_bins)))
+        mask = (leaf_ref[:, 0:1] == root_slot).astype(cdt)  # [blk, 1]
+        ghl = mask * ghk_ref[:].astype(cdt)                 # [blk, kc_pad]
+        return jax.lax.dot_general(
+            onehot, ghl, (((0,), (0,)), ((), ())),
+            preferred_element_type=acc_dt)                  # [fb_pad, kc_pad]
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = compute()
+
+    @pl.when(j > 0)
+    def _():
+        out_ref[:] = out_ref[:] + compute()
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_bins", "hist_dtype", "interpret", "root_slot"))
+def build_root_histograms_classes(bins: jax.Array, gh_k: jax.Array,
+                                  row_leaf: jax.Array, *, num_bins: int,
+                                  hist_dtype: str = "bfloat16",
+                                  interpret: bool = False,
+                                  root_slot: int = 0) -> jax.Array:
+    """Root histograms for all K classes with ONE pass over bins.
+
+    bins [R, F], gh_k [K, R, 3] (f32 or int8 quantized), row_leaf [R]
+    int32 → [K, F, B, 3] (f32; int32 when quantized). Bit-equal to K
+    independent `build_histograms_pallas` root launches: the per-class
+    lanes hit the same MXU contraction against the same one-hot, in the
+    same row-block order."""
+    if not _HAS_PALLAS:
+        raise RuntimeError("pallas unavailable in this jax build")
+    R, F = bins.shape
+    K = int(gh_k.shape[0])
+    B = int(num_bins)
+    quant = gh_k.dtype == jnp.int8
+    cdt = jnp.int8 if quant else jnp.dtype(hist_dtype)
+    acc_dt = jnp.int32 if quant else jnp.float32
+    blk, fc, Bp, _ = _plan_chunks(F, B, max(K, 1))
+    fb_pad = -(-(fc * Bp) // 128) * 128
+    kc = K * HIST_CH
+    kc_pad = -(-kc // 128) * 128
+
+    r_pad = ((R + blk - 1) // blk) * blk
+    if r_pad != R:
+        bins = jnp.pad(bins, ((0, r_pad - R), (0, 0)))
+        gh_k = jnp.pad(gh_k, ((0, 0), (0, r_pad - R), (0, 0)))
+        row_leaf = jnp.pad(row_leaf, (0, r_pad - R), constant_values=-1)
+    n_fb = F // fc
+    n_rb = r_pad // blk
+
+    ghk = gh_k.transpose(1, 0, 2).reshape(r_pad, kc)      # [R, K*3]
+    if kc_pad != kc:
+        ghk = jnp.pad(ghk, ((0, 0), (0, kc_pad - kc)))
+    leaf8 = jnp.broadcast_to(row_leaf[:, None].astype(jnp.int32),
+                             (r_pad, 8))
+
+    out = pl.pallas_call(
+        functools.partial(_class_kernel, num_bins=Bp, cdt=cdt,
+                          fb_pad=fb_pad, kc_pad=kc_pad, acc_dt=acc_dt,
+                          root_slot=root_slot),
+        grid=(n_fb, n_rb),
+        in_specs=[
+            pl.BlockSpec((blk, fc), lambda i, j: (j, i)),
+            pl.BlockSpec((blk, kc_pad), lambda i, j: (j, 0)),
+            pl.BlockSpec((blk, 8), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((fb_pad, kc_pad), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n_fb * fb_pad, kc_pad), acc_dt),
+        compiler_params=_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(bins.astype(jnp.int32), ghk, leaf8)
+
+    hist = out.reshape(n_fb, fb_pad, kc_pad)[:, :fc * Bp, :kc]
+    hist = hist.reshape(n_fb, fc, Bp, K, HIST_CH)[:, :, :B, :, :]
+    return hist.reshape(F, B, K, HIST_CH).transpose(2, 0, 1, 3)

@@ -50,7 +50,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["SplitParams", "find_best_splits", "leaf_output", "leaf_gain",
-           "gain_given_output", "calc_output", "monotone_penalty_factor"]
+           "gain_given_output", "calc_output", "monotone_penalty_factor",
+           "eval_split_lattice", "pack_member_bitset"]
 
 NEG_INF = -jnp.inf
 K_EPS = 1e-15
@@ -118,72 +119,56 @@ def monotone_penalty_factor(depth, penalization):
     return jnp.where(penalization >= depth + 1.0, K_EPS, pen)
 
 
-def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
-                     nan_bin: jax.Array, is_cat: jax.Array,
-                     params: SplitParams,
-                     feature_mask: Optional[jax.Array] = None,
-                     mono_type: Optional[jax.Array] = None,
-                     leaf_lo: Optional[jax.Array] = None,
-                     leaf_hi: Optional[jax.Array] = None,
-                     parent_output: Optional[jax.Array] = None,
-                     slot_depth: Optional[jax.Array] = None,
-                     rand_bin: Optional[jax.Array] = None,
-                     cat_sorted_mask: Optional[jax.Array] = None,
-                     return_feature_gain: bool = False,
-                     gain_scale: Optional[jax.Array] = None,
-                     gain_penalty: Optional[jax.Array] = None,
-                     adv_bounds: Optional[tuple] = None
-                     ) -> Dict[str, jax.Array]:
-    """Vectorized best split per leaf.
+def pack_member_bitset(member: jax.Array) -> jax.Array:
+    """Pack a [L, B] bin-membership mask into uint32 words (tree.h cat
+    bitset layout). Shared by `find_best_splits` and the fused-kernel
+    postlude in ops/pallas_histogram.py."""
+    L, B = member.shape
+    BW = (B + 31) // 32
+    pad = BW * 32 - B
+    member_p = jnp.pad(member, ((0, 0), (0, pad)))
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(
+        member_p.reshape(L, BW, 32).astype(jnp.uint32) * weights[None, None],
+        axis=2, dtype=jnp.uint32)
 
-    Args:
-      hist: [L, F, B, 3] (sum_grad, sum_hess, count) per (leaf, feature, bin).
-      num_bins_per_feat: [F] or [L, F] int32 — valid bins per feature
-        (<= B). All per-feature metadata below likewise accepts a
-        per-slot [L, F] form — the voting-parallel learner's per-leaf
-        elected feature subsets remap columns per slot.
-      nan_bin: [F] or [L, F] int32 — NaN bin index, -1 if none.
-      is_cat: [F] or [L, F] bool — categorical feature flags.
-      params: SplitParams.
-      feature_mask: optional [F] or [L, F] bool — candidate features,
-        applied BEFORE the argmax (per-tree sampling, per-node sampling,
-        interaction constraints).
-      mono_type: optional [F] or [L, F] int32 in {-1, 0, 1}.
-      leaf_lo / leaf_hi: optional [L] f32 — per-leaf output bounds
-        (BasicConstraint of monotone_constraints.hpp).
-      parent_output: optional [L] f32 — each slot's current output
-        (unshrunk), required when path_smooth > 0.
-      slot_depth: optional [L] int32 — leaf depth, for monotone_penalty.
-      rand_bin: optional [L, F] int32 — extra-trees random threshold;
-        only this bin is evaluated per (leaf, feature).
-      cat_sorted_mask: optional [F] or per-slot [L, F] bool —
-        categorical features with more than max_cat_to_onehot bins;
-        they take the sorted-subset path (ops/cat_split.py) instead of
-        one-hot (voting-parallel passes the per-slot elected form).
-      return_feature_gain: also return "feature_gain" [L, F] — the best
-        net gain per (leaf, feature) — for voting-parallel vote rounds.
-      gain_scale: optional [F] or [L, F] f32 — multiplies each feature's
-        net gain (feature_contri, feature_histogram.hpp:174
-        ``output->gain *= meta_->penalty``).
-      gain_penalty: optional [L, F] f32 — subtracted from each feature's
-        net gain AFTER scaling (CEGB DeltaGain,
-        cost_effective_gradient_boosting.hpp:80-98).
-      adv_bounds: optional (lo_l, hi_l, lo_r, hi_r), each [L, F, B] f32
-        — monotone_constraints_method=advanced per-candidate output
-        bounds (AdvancedConstraintEntry's per-threshold-segment
-        constraints, monotone_constraints.hpp:858, in dense lattice
-        form). When given, they replace the scalar leaf_lo/leaf_hi clip
-        for the threshold lattice; leaf_lo/leaf_hi (scalars, computed by
-        the caller for whole-leaf adjacency) still drive the sorted-cat
-        path.
 
-    Returns dict with per-leaf arrays:
-      gain [L] — NET gain (split - parent - min_gain_to_split, penalized;
-        -inf when no valid split), feature [L], threshold [L],
-      default_left [L] bool, left_sum/right_sum [L, 3],
-      left_out/right_out [L] (constrained outputs), is_cat_split [L],
-      cat_bitset [L, ceil(B/32)] uint32 — bin-space LEFT subset for
-        categorical winners (single bit for one-hot).
+def eval_split_lattice(hist: jax.Array, num_bins_per_feat: jax.Array,
+                       nan_bin: jax.Array, is_cat: jax.Array,
+                       params: SplitParams,
+                       feature_mask: Optional[jax.Array] = None,
+                       mono_type: Optional[jax.Array] = None,
+                       leaf_lo: Optional[jax.Array] = None,
+                       leaf_hi: Optional[jax.Array] = None,
+                       parent_output: Optional[jax.Array] = None,
+                       mono_pen: Optional[jax.Array] = None,
+                       rand_bin: Optional[jax.Array] = None,
+                       cat_sorted_mask: Optional[jax.Array] = None,
+                       gain_scale: Optional[jax.Array] = None,
+                       gain_penalty: Optional[jax.Array] = None,
+                       adv_bounds: Optional[tuple] = None,
+                       quant_scales: Optional[jax.Array] = None
+                       ) -> Dict[str, jax.Array]:
+    """Dense gain-lattice evaluation shared by `find_best_splits` and the
+    fused Pallas epilogue (ops/pallas_histogram.py) — everything up to but
+    excluding the argmax, so a per-chunk kernel invocation can run the
+    same math on a VMEM-resident histogram block.
+
+    Same operands/semantics as `find_best_splits` except:
+      mono_pen: optional [L] f32 — precomputed
+        `monotone_penalty_factor(slot_depth, params.monotone_penalty)`
+        (the depth→penalty map is the caller's job here since the kernel
+        epilogue streams depths in as a metadata row).
+      quant_scales: optional [2] or [L, 2] f32 — (g_scale, h_scale) for
+        int8-quantized training. When given, `hist` holds raw int32
+        accumulator sums; prefix scans run EXACTLY in integers and the
+        cumulative sums are rescaled to f32 grid values only at gain
+        time (the ISSUE-14 exact-scan path; contrast the legacy two-pass
+        flow which dequantizes the full histogram first).
+
+    Returns dict: net [L,F,B,2] (NEG_INF where invalid), left/right
+    [L,F,B,2,3] (f32 grid values), out_l/out_r [L,F,B,2], pg [L,F],
+    totals [L,F,3] (f32 grid values), is_cat2 [M,F].
     """
     L, F, B, _ = hist.shape
     l1, l2 = params.lambda_l1, params.lambda_l2
@@ -204,7 +189,8 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     # zero out the nan bin so cumsums cover non-missing rows only
     nan_mask = ((bins_iota[None, None, :] == nan2[:, :, None])
                 & has_nan[:, :, None])                         # [M, F, B]
-    hist_nonan = jnp.where(nan_mask[:, :, :, None], 0.0, hist)
+    hist_nonan = jnp.where(nan_mask[:, :, :, None],
+                           jnp.zeros((), hist.dtype), hist)
     nan_sum = (hist * nan_mask[:, :, :, None]).sum(axis=2)     # [L, F, 3]
 
     totals = hist_nonan.sum(axis=2) + nan_sum                  # [L, F, 3]
@@ -234,8 +220,11 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     cat_right = tot[:, :, :, None, :] - cat_left
     cat_ok = ((bins_iota[None, None, :] < nnb[:, :, None])
               & onehot_f[:, :, None])                          # [M, F, B]
-    cat_valid = (cat_ok[:, :, :, None]
-                 & jnp.array([True, False])[None, None, None, :])
+    # option-0 selector built from an iota (not a literal [True, False]
+    # constant) so the Pallas kernel epilogue can trace this body —
+    # pallas_call rejects captured array constants
+    opt0 = jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, 2), 3) == 0
+    cat_valid = cat_ok[:, :, :, None] & opt0
 
     catsel = cat2[:, :, None, None, None]
     left = jnp.where(catsel, cat_left, num_left)
@@ -244,6 +233,22 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     if rand_bin is not None:  # extra_trees: one threshold per (leaf, feat)
         valid = valid & (bins_iota[None, None, :, None]
                          == rand_bin[:, :, None, None])
+
+    if quant_scales is not None:
+        # exact integer scan → grid-value rescale at gain time; the count
+        # channel scales by 1 so min_data thresholds stay exact
+        qs = quant_scales.astype(jnp.float32)
+        if qs.ndim == 1:
+            qv = jnp.concatenate([qs, jnp.ones((1,), jnp.float32)])
+            left = left.astype(jnp.float32) * qv
+            right = right.astype(jnp.float32) * qv
+            totals = totals.astype(jnp.float32) * qv
+        else:                                                  # [L, 2]
+            qv = jnp.concatenate(
+                [qs, jnp.ones((qs.shape[0], 1), jnp.float32)], axis=1)
+            left = left.astype(jnp.float32) * qv[:, None, None, None, :]
+            right = right.astype(jnp.float32) * qv[:, None, None, None, :]
+            totals = totals.astype(jnp.float32) * qv[:, None, :]
 
     gL, hL, nL = left[..., 0], left[..., 1], left[..., 2]
     gR, hR, nR = right[..., 0], right[..., 1], right[..., 2]
@@ -305,9 +310,8 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
     net = jnp.where(ok & (net > 1e-10), net, NEG_INF)
 
     if use_mono and params.monotone_penalty > 0.0:
-        pen = monotone_penalty_factor(slot_depth, params.monotone_penalty)
         mt = mono2[:, :, None, None]
-        net = jnp.where(mt != 0, net * pen[:, None, None, None], net)
+        net = jnp.where(mt != 0, net * mono_pen[:, None, None, None], net)
 
     if gain_scale is not None:
         gs2 = gain_scale if gain_scale.ndim == 2 else gain_scale[None, :]
@@ -325,6 +329,105 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         fm = (feature_mask[None, :] if feature_mask.ndim == 1
               else feature_mask)                                # [L, F]
         net = jnp.where(fm[:, :, None, None], net, NEG_INF)
+
+    return {"net": net, "left": left, "right": right,
+            "out_l": out_l, "out_r": out_r, "pg": pg,
+            "totals": totals, "is_cat2": cat2}
+
+
+def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
+                     nan_bin: jax.Array, is_cat: jax.Array,
+                     params: SplitParams,
+                     feature_mask: Optional[jax.Array] = None,
+                     mono_type: Optional[jax.Array] = None,
+                     leaf_lo: Optional[jax.Array] = None,
+                     leaf_hi: Optional[jax.Array] = None,
+                     parent_output: Optional[jax.Array] = None,
+                     slot_depth: Optional[jax.Array] = None,
+                     rand_bin: Optional[jax.Array] = None,
+                     cat_sorted_mask: Optional[jax.Array] = None,
+                     return_feature_gain: bool = False,
+                     gain_scale: Optional[jax.Array] = None,
+                     gain_penalty: Optional[jax.Array] = None,
+                     adv_bounds: Optional[tuple] = None,
+                     quant_scales: Optional[jax.Array] = None
+                     ) -> Dict[str, jax.Array]:
+    """Vectorized best split per leaf.
+
+    Args:
+      hist: [L, F, B, 3] (sum_grad, sum_hess, count) per (leaf, feature, bin).
+      num_bins_per_feat: [F] or [L, F] int32 — valid bins per feature
+        (<= B). All per-feature metadata below likewise accepts a
+        per-slot [L, F] form — the voting-parallel learner's per-leaf
+        elected feature subsets remap columns per slot.
+      nan_bin: [F] or [L, F] int32 — NaN bin index, -1 if none.
+      is_cat: [F] or [L, F] bool — categorical feature flags.
+      params: SplitParams.
+      feature_mask: optional [F] or [L, F] bool — candidate features,
+        applied BEFORE the argmax (per-tree sampling, per-node sampling,
+        interaction constraints).
+      mono_type: optional [F] or [L, F] int32 in {-1, 0, 1}.
+      leaf_lo / leaf_hi: optional [L] f32 — per-leaf output bounds
+        (BasicConstraint of monotone_constraints.hpp).
+      parent_output: optional [L] f32 — each slot's current output
+        (unshrunk), required when path_smooth > 0.
+      slot_depth: optional [L] int32 — leaf depth, for monotone_penalty.
+      rand_bin: optional [L, F] int32 — extra-trees random threshold;
+        only this bin is evaluated per (leaf, feature).
+      cat_sorted_mask: optional [F] or per-slot [L, F] bool —
+        categorical features with more than max_cat_to_onehot bins;
+        they take the sorted-subset path (ops/cat_split.py) instead of
+        one-hot (voting-parallel passes the per-slot elected form).
+      return_feature_gain: also return "feature_gain" [L, F] — the best
+        net gain per (leaf, feature) — for voting-parallel vote rounds.
+      gain_scale: optional [F] or [L, F] f32 — multiplies each feature's
+        net gain (feature_contri, feature_histogram.hpp:174
+        ``output->gain *= meta_->penalty``).
+      gain_penalty: optional [L, F] f32 — subtracted from each feature's
+        net gain AFTER scaling (CEGB DeltaGain,
+        cost_effective_gradient_boosting.hpp:80-98).
+      adv_bounds: optional (lo_l, hi_l, lo_r, hi_r), each [L, F, B] f32
+        — monotone_constraints_method=advanced per-candidate output
+        bounds (AdvancedConstraintEntry's per-threshold-segment
+        constraints, monotone_constraints.hpp:858, in dense lattice
+        form). When given, they replace the scalar leaf_lo/leaf_hi clip
+        for the threshold lattice; leaf_lo/leaf_hi (scalars, computed by
+        the caller for whole-leaf adjacency) still drive the sorted-cat
+        path.
+
+    Returns dict with per-leaf arrays:
+      gain [L] — NET gain (split - parent - min_gain_to_split, penalized;
+        -inf when no valid split), feature [L], threshold [L],
+      default_left [L] bool, left_sum/right_sum [L, 3],
+      left_out/right_out [L] (constrained outputs), is_cat_split [L],
+      cat_bitset [L, ceil(B/32)] uint32 — bin-space LEFT subset for
+        categorical winners (single bit for one-hot).
+
+    quant_scales: optional [2] or [L, 2] f32 (g_scale, h_scale) — when
+    given, `hist` holds raw int32 quantized accumulator sums and the scan
+    runs exactly in integers with a grid-value rescale at gain time (see
+    `eval_split_lattice`). Incompatible with `cat_sorted_mask` (the
+    sorted-cat path expects dequantized histograms).
+    """
+    L, F, B, _ = hist.shape
+    if quant_scales is not None and cat_sorted_mask is not None:
+        raise ValueError("quant_scales is incompatible with cat_sorted_mask")
+    mono_pen = None
+    if mono_type is not None and params.monotone_penalty > 0.0:
+        mono_pen = monotone_penalty_factor(slot_depth,
+                                           params.monotone_penalty)
+    lat = eval_split_lattice(
+        hist, num_bins_per_feat, nan_bin, is_cat, params,
+        feature_mask=feature_mask, mono_type=mono_type,
+        leaf_lo=leaf_lo, leaf_hi=leaf_hi, parent_output=parent_output,
+        mono_pen=mono_pen, rand_bin=rand_bin,
+        cat_sorted_mask=cat_sorted_mask, gain_scale=gain_scale,
+        gain_penalty=gain_penalty, adv_bounds=adv_bounds,
+        quant_scales=quant_scales)
+    net, left, right = lat["net"], lat["left"], lat["right"]
+    out_l, out_r, pg, cat2 = (lat["out_l"], lat["out_r"], lat["pg"],
+                              lat["is_cat2"])
+    bins_iota = jnp.arange(B, dtype=jnp.int32)
 
     # ---- argmax over (F, B, 2) per leaf
     flat = net.reshape(L, F * B * 2)
@@ -403,12 +506,5 @@ def find_best_splits(hist: jax.Array, num_bins_per_feat: jax.Array,
         out["is_cat_split"] = jnp.where(pick, True, out["is_cat_split"])
         member = jnp.where(pick[:, None], srt["member"], member)
 
-    # pack [L, B] membership into uint32 words (tree.h cat bitset layout)
-    BW = (B + 31) // 32
-    pad = BW * 32 - B
-    member_p = jnp.pad(member, ((0, 0), (0, pad)))
-    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
-    out["cat_bitset"] = jnp.sum(
-        member_p.reshape(L, BW, 32).astype(jnp.uint32) * weights[None, None],
-        axis=2, dtype=jnp.uint32)
+    out["cat_bitset"] = pack_member_bitset(member)
     return out

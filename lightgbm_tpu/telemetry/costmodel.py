@@ -31,6 +31,7 @@ __all__ = ["TPU_PEAKS", "HIST_CH", "CostReport", "cost_report",
            "instruction_phase_map", "module_name",
            "fused_compiled", "booster_phase_maps",
            "staged_cost_reports", "analytical_hist_counts",
+           "analytical_build_split_counts",
            "kernel_roofline_fields", "roofline_utilization",
            "hist_xla_cost", "chip_peaks"]
 
@@ -79,6 +80,36 @@ def analytical_hist_counts(R: int, F: int, B: int,
     uint8 + gh f32 in, hist f32 out)."""
     flops = 2.0 * R * (F * B) * (L * HIST_CH)
     bytes_ = R * F + R * HIST_CH * 4 + F * B * L * HIST_CH * 4
+    return flops, bytes_
+
+
+def analytical_build_split_counts(R: int, F: int, B: int, L: int, *,
+                                  fused: bool,
+                                  emit_hist: bool = False
+                                  ) -> Tuple[float, float]:
+    """(flops, bytes) of one full BUILD+SPLIT pass — histogram plus the
+    best-split gain scan, the quantity the fused kernel optimizes.
+
+    Two-pass: the [F, B, L, CH] f32 histogram goes to HBM once
+    (`analytical_hist_counts` already prices the write) and the split
+    scan reads it back — one extra lattice-sized stream. Fused: the
+    epilogue scans the VMEM-resident block, so the lattice never
+    round-trips; the only extra HBM traffic is the per-(feature-chunk,
+    leaf) candidate-record stream (`fused_candidate_bytes`), with the
+    lattice write retained only in `emit_hist` mode (subtraction-cache
+    feeding). The scan's flops (a few prefix-sum passes over the
+    lattice) are identical either way and negligible next to the
+    one-hot matmul; counted once as 8 ops/cell so the ratio stays a
+    pure bytes story."""
+    flops, hist_bytes = analytical_hist_counts(R, F, B, L)
+    lattice = F * B * L * HIST_CH * 4
+    flops += 8.0 * F * B * L * HIST_CH
+    if not fused:
+        return flops, hist_bytes + lattice
+    from ..ops.pallas_histogram import fused_candidate_bytes
+    bytes_ = (hist_bytes - lattice) + fused_candidate_bytes(F, B, L)
+    if emit_hist:
+        bytes_ += lattice
     return flops, bytes_
 
 

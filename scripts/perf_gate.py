@@ -88,6 +88,24 @@ def collect_metrics(skip_timing: bool = False
     if ana_flops > 0 and xla["flops"] > 0:
         metrics["hist_flops_xla_ratio"] = xla["flops"] / ana_flops
 
+    # fused build+split analytical bytes (ISSUE 14): the acceptance
+    # that the [F, B, L, 3] HBM round-trip between the hist and split
+    # phases is gone from the fused path. Pure lattice functions, so
+    # fused < two-pass is a hard invariant of the cost model, checked
+    # here directly — not a baseline band that --update could erode.
+    _, by2 = costmodel.analytical_build_split_counts(
+        HIST_R, HIST_F, HIST_B, HIST_L, fused=False)
+    _, byf = costmodel.analytical_build_split_counts(
+        HIST_R, HIST_F, HIST_B, HIST_L, fused=True)
+    if not byf < by2:
+        raise AssertionError(
+            f"fused build+split bytes ({byf:g}) not below two-pass "
+            f"({by2:g}) on the probe lattice — the fused epilogue no "
+            "longer eliminates the histogram round-trip")
+    metrics["hist_bytes_twopass"] = float(by2)
+    metrics["hist_bytes_fused"] = float(byf)
+    metrics["hist_fused_bytes_reduction"] = 1.0 - byf / by2
+
     # staged-program prices of the canonical booster
     bst = _canonical_booster()
     for rep in costmodel.staged_cost_reports(bst).values():
@@ -104,12 +122,12 @@ def collect_metrics(skip_timing: bool = False
                        "ingest_chunked_ms_per_tree",
                        "ingest_resident_ms_per_tree")
     if skip_timing:
-        skipped.append("ms_per_tree")
+        skipped.extend(("ms_per_tree", "split_scan_ms"))
         skipped.extend(_INGEST_METRICS)
     elif not perf.host_quiet():
         print("perf-gate: host not quiet (loadavg); skipping timing",
               file=sys.stderr)
-        skipped.append("ms_per_tree")
+        skipped.extend(("ms_per_tree", "split_scan_ms"))
         skipped.extend(_INGEST_METRICS)
     else:
         gb = bst._gbdt
@@ -133,7 +151,40 @@ def collect_metrics(skip_timing: bool = False
             print(f"perf-gate: ingest probe failed ({e}); skipping",
                   file=sys.stderr)
             skipped.extend(_INGEST_METRICS)
+        # split-scan wall-clock (ISSUE 14): the standalone pass the
+        # fused kernel absorbs, on bench.py's probe lattice
+        try:
+            metrics["split_scan_ms"] = _split_scan_ms()
+        except Exception as e:  # noqa: BLE001
+            print(f"perf-gate: split-scan probe failed ({e}); skipping",
+                  file=sys.stderr)
+            skipped.append("split_scan_ms")
     return metrics, skipped
+
+
+def _split_scan_ms() -> float:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.split import SplitParams, find_best_splits
+    rng = np.random.default_rng(7)
+    h = rng.normal(size=(HIST_L, HIST_F, HIST_B, 3)).astype(np.float32)
+    h[..., 1:] = np.abs(h[..., 1:]) * 8.0
+    nb = jnp.full((HIST_F,), HIST_B, jnp.int32)
+    nan_pf = jnp.full((HIST_F,), -1, jnp.int32)
+    cat = jnp.zeros((HIST_F,), bool)
+    sp = SplitParams(min_data_in_leaf=20,
+                     min_sum_hessian_in_leaf=1e-3)
+    scan = jax.jit(lambda x: find_best_splits(
+        x, nb, nan_pf, cat, sp)["gain"])
+    hj = jnp.asarray(h)
+    scan(hj).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        g = scan(hj)
+    g.block_until_ready()
+    return (time.perf_counter() - t0) / 5 * 1e3
 
 
 _TIMING_KINDS = ("time", "throughput")
@@ -175,6 +226,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         metrics = {k: v * 2.0 for k, v in metrics.items()}
 
     if ns.update:
+        # metrics this run deliberately skipped (timing on a loaded
+        # host) keep their previous blessing — dropping them would
+        # silently shrink the gate's coverage
+        try:
+            prev = perf.load_baseline(ns.baseline).get("metrics", {})
+        except (FileNotFoundError, ValueError):
+            prev = {}
+        for name in skipped:
+            if name in prev and name not in metrics:
+                metrics[name] = prev[name]
         perf.save_baseline(ns.baseline, metrics, meta={
             "workload": {"rows": N_ROWS, "feats": N_FEATS,
                          "num_leaves": NUM_LEAVES,
