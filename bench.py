@@ -532,16 +532,69 @@ def phase_profile_fields(bst, iters: int = 4) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
+def _http_burst(port, body, rows_per_req, clients, reqs_each,
+                on_resp=None):
+    """reqs_each sequential requests from each of `clients` keep-alive
+    connections against /predict; returns (rows/s, p99_ms, errors).
+    Shared by serve_bench and fleet_bench so the legacy and fleet
+    servers are measured through the identical client harness."""
+    import http.client
+    import threading
+
+    lat, errors = [], []
+    lock = threading.Lock()
+
+    def client():
+        conn = http.client.HTTPConnection("127.0.0.1", port,
+                                          timeout=60)
+        try:
+            for _ in range(reqs_each):
+                t0 = time.time()
+                conn.request(
+                    "POST", "/predict", body=body,
+                    headers={"Content-Type": "application/x-npy"})
+                r = conn.getresponse()
+                data = r.read()
+                dt = time.time() - t0
+                if r.status != 200:
+                    raise RuntimeError(
+                        f"status {r.status}: {data[:200]}")
+                with lock:
+                    lat.append(dt)
+                if on_resp is not None:
+                    on_resp(data)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            conn.close()
+
+    threads = [threading.Thread(target=client)
+               for _ in range(clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.time() - t0
+    done = len(lat)
+    rps = done * rows_per_req / wall if wall > 0 else 0.0
+    p99 = (float(np.percentile(lat, 99)) * 1e3 if lat else 0.0)
+    return rps, p99, errors
+
+
 def serve_bench(bst, Xv) -> dict:
     """Serving probes (ISSUE 2): end-to-end HTTP throughput + p99 at
     1/8/64 concurrent clients against the micro-batched prediction
     server, plus a mid-burst hot-swap probe. BENCH_SERVE=0 skips.
 
-    The acceptance numbers: `serve_rows_per_s` (the 8-client figure)
-    must reach >= 3x `serve_rows_per_s_c1` (single-client sequential —
-    coalescing actually amortizes the per-request fixed cost),
+    The acceptance numbers: `serve_rows_per_s_c8` must reach >= 3x
+    `serve_rows_per_s_c1` (single-client sequential — coalescing
+    actually amortizes the per-request fixed cost),
     `serve_mean_batch_rows` > 1, and the swap probe must complete with
-    zero failed requests and zero mixed-version results."""
+    zero failed requests and zero mixed-version results. The headline
+    `serve_rows_per_s` / `serve_p99_ms` figures come from fleet_bench
+    (the compiled-ensemble fleet, ISSUE 15)."""
     import http.client
     import tempfile
     import threading
@@ -569,49 +622,8 @@ def serve_bench(bst, Xv) -> dict:
         port = srv.start()
 
         def burst(clients: int, reqs_each: int, on_resp=None):
-            """reqs_each sequential requests from each of `clients`
-            keep-alive connections; returns (rows/s, p99_ms, errors)."""
-            lat, errors = [], []
-            lock = threading.Lock()
-
-            def client():
-                conn = http.client.HTTPConnection("127.0.0.1", port,
-                                                  timeout=60)
-                try:
-                    for _ in range(reqs_each):
-                        t0 = time.time()
-                        conn.request(
-                            "POST", "/predict", body=body,
-                            headers={"Content-Type":
-                                     "application/x-npy"})
-                        r = conn.getresponse()
-                        data = r.read()
-                        dt = time.time() - t0
-                        if r.status != 200:
-                            raise RuntimeError(
-                                f"status {r.status}: {data[:200]}")
-                        with lock:
-                            lat.append(dt)
-                        if on_resp is not None:
-                            on_resp(data)
-                except Exception as e:  # noqa: BLE001
-                    with lock:
-                        errors.append(f"{type(e).__name__}: {e}")
-                finally:
-                    conn.close()
-
-            threads = [threading.Thread(target=client)
-                       for _ in range(clients)]
-            t0 = time.time()
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            wall = time.time() - t0
-            done = len(lat)
-            rps = done * rows_per_req / wall if wall > 0 else 0.0
-            p99 = (float(np.percentile(lat, 99)) * 1e3 if lat else 0.0)
-            return rps, p99, errors
+            return _http_burst(port, body, rows_per_req, clients,
+                               reqs_each, on_resp)
 
         burst(2, 3)   # warm the HTTP path + every ladder bucket in play
         for clients in (1, 8, 64):
@@ -623,11 +635,9 @@ def serve_bench(bst, Xv) -> dict:
                 fields[f"serve_errors_c{clients}"] = errors[:3]
             print(f"serve: {clients} clients x {reqs_each} reqs -> "
                   f"{rps:.0f} rows/s, p99 {p99:.1f} ms", file=sys.stderr)
-        fields["serve_rows_per_s"] = fields["serve_rows_per_s_c8"]
-        fields["serve_p99_ms"] = fields["serve_p99_ms_c8"]
         c1 = fields["serve_rows_per_s_c1"]
         fields["serve_batching_speedup"] = round(
-            fields["serve_rows_per_s"] / c1, 2) if c1 else 0.0
+            fields["serve_rows_per_s_c8"] / c1, 2) if c1 else 0.0
 
         # mid-burst hot-swap probe: every in-burst result must match one
         # WHOLE version (the truncated-ensemble v2 differs from v1 far
@@ -675,6 +685,87 @@ def serve_bench(bst, Xv) -> dict:
             srv.metrics.mean_batch_rows(), 2)
         fields["serve_batches_total"] = srv.metrics.batches_total.value
         srv.stop()
+    return fields
+
+
+def fleet_bench(bst, Xv, *, replica_counts=(1, 2, 4, 8), clients=64,
+                reqs_each=4) -> dict:
+    """Compiled-ensemble replica-fleet ablation (ISSUE 15): `clients`
+    concurrent keep-alive connections against the tensorized XLA
+    predict program at each replica count in `replica_counts`, vs the
+    per-tree-dispatch PredictSession path through the same HTTP front
+    end. Shares serve_bench's BENCH_SERVE=0 gate.
+
+    Acceptance: `compiled_predict_speedup` (single-replica compiled
+    over the packed walk, same 64-client load) >= 1, and rows/s scales
+    near-linearly 1->8 replicas where the mesh has the devices. On a
+    single-device host the replicas time-share one core, so the
+    scaling curve flattens — the bench reports what it measured; the
+    multi-device scaling claim is exercised on mesh hosts. The
+    headline `serve_rows_per_s` / `serve_p99_ms` are the max-replica
+    figures (the configuration a fleet deploy would run)."""
+    import tempfile
+
+    from lightgbm_tpu.serving import PredictionServer
+
+    rows_per_req = int(os.environ.get("BENCH_SERVE_ROWS", 16))
+    Xq = np.ascontiguousarray(Xv[:rows_per_req], np.float64)
+    buf = __import__("io").BytesIO()
+    np.save(buf, Xq)
+    body = buf.getvalue()
+    fields = {"serve_fleet_clients": clients}
+
+    with tempfile.TemporaryDirectory(prefix="bench_fleet_") as td:
+        mf = os.path.join(td, "m.txt")
+        bst.save_model(mf)
+
+        def measure(**srv_opts):
+            srv = PredictionServer(port=0, max_batch_rows=1024,
+                                   max_wait_us=2000, **srv_opts)
+            srv.registry.register("default", mf)
+            port = srv.start()
+            try:
+                _http_burst(port, body, rows_per_req,
+                            min(8, clients), 2)   # warm the HTTP path
+                return _http_burst(port, body, rows_per_req,
+                                   clients, reqs_each)
+            finally:
+                srv.stop()
+
+        # comparator: the packed per-tree-dispatch walk (PR 1 path)
+        # under the identical client load
+        walk_rps, walk_p99, walk_err = measure()
+        fields["serve_rows_per_s_walk"] = round(walk_rps, 1)
+        fields["serve_p99_ms_walk"] = round(walk_p99, 2)
+        if walk_err:
+            fields["serve_errors_walk"] = walk_err[:3]
+        print(f"fleet: packed walk x {clients} clients -> "
+              f"{walk_rps:.0f} rows/s, p99 {walk_p99:.1f} ms",
+              file=sys.stderr)
+
+        r1_rps = 0.0
+        for nrep in replica_counts:
+            rps, p99, errors = measure(compiled_predict=True,
+                                       replicas=nrep)
+            fields[f"serve_rows_per_s_r{nrep}"] = round(rps, 1)
+            fields[f"serve_p99_ms_r{nrep}"] = round(p99, 2)
+            if errors:
+                fields[f"serve_errors_r{nrep}"] = errors[:3]
+            if nrep == replica_counts[0]:
+                r1_rps = rps
+            print(f"fleet: {nrep} replicas x {clients} clients -> "
+                  f"{rps:.0f} rows/s, p99 {p99:.1f} ms",
+                  file=sys.stderr)
+
+        top = replica_counts[-1]
+        fields["serve_rows_per_s"] = fields[f"serve_rows_per_s_r{top}"]
+        fields["serve_p99_ms"] = fields[f"serve_p99_ms_r{top}"]
+        if walk_rps:
+            fields["compiled_predict_speedup"] = round(
+                r1_rps / walk_rps, 2)
+        if r1_rps:
+            fields["serve_fleet_scaling"] = round(
+                fields["serve_rows_per_s"] / r1_rps, 2)
     return fields
 
 
@@ -1448,6 +1539,10 @@ def main():
             serve_fields = serve_bench(bst, Xv)
         except Exception as e:  # noqa: BLE001 — probes never kill bench
             print(f"serve bench failed: {e}", file=sys.stderr)
+        try:
+            serve_fields.update(fleet_bench(bst, Xv))
+        except Exception as e:  # noqa: BLE001 — probes never kill bench
+            print(f"fleet bench failed: {e}", file=sys.stderr)
 
     ref_fields = ref_same_host_probe(X, y, Xv, yv, iters, max_bin)
 

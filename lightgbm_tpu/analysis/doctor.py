@@ -20,6 +20,11 @@ Entry points (per canonical config):
   must stay within the power-of-two bucket ladder
   (``log2(max_batch_rows) + 1`` signatures, TD201) and its program
   lints clean.
+- **serving compiled** — the tensorized whole-ensemble program
+  (``codegen.CompiledEnsemble``, ISSUE 15): no collectives, no host
+  callbacks (TD002), and a full ladder warm must leave exactly one
+  compiled signature per rung (TD201 — the registry's zero-on-path-
+  compiles publish gate).
 
 Canonical configs are the feature matrix the repo actually ships:
 plain / EFB / quantized / categorical, each under serial and (when the
@@ -47,8 +52,8 @@ from .report import TraceReport, merge_errors
 
 __all__ = ["CANONICAL_CONFIGS", "PARALLEL_MODES", "make_booster",
            "doctor_fused_step", "doctor_tree_builder", "doctor_predict",
-           "doctor_batcher", "doctor_fused_split", "run_doctor",
-           "doctor_main"]
+           "doctor_batcher", "doctor_serving", "doctor_fused_split",
+           "run_doctor", "doctor_main"]
 
 # name -> (train-param overrides, dataset kwargs)
 CANONICAL_CONFIGS: Dict[str, Tuple[dict, dict]] = {
@@ -293,6 +298,47 @@ def doctor_batcher(bst, *, label: str = "serving_batcher",
                      allowed_phases=frozenset(), allow=allow)]
 
 
+def doctor_serving(bst, *, label: str = "serving_compiled",
+                   max_batch_rows: int = 64, min_bucket: int = 8,
+                   allow: Sequence[Tuple[str, str]] = ()
+                   ) -> List[TraceReport]:
+    """Lint the tensorized compiled-ensemble serving program (ISSUE
+    15): the whole-ensemble gather walk must stage no collectives and
+    no host callbacks (TD002 — one self-contained XLA program per
+    request batch is the fleet's latency contract), and warming the
+    full batch ladder must leave exactly one compiled signature per
+    rung (TD201: the registry publishes a version only after ``warm``,
+    so any signature beyond the ladder is an on-path compile waiting
+    to happen)."""
+    from ..codegen import CompiledEnsemble
+
+    rep = TraceReport(label=label)
+    try:
+        ce = CompiledEnsemble(bst)
+    except (ValueError, TypeError) as e:
+        rep.add("TD000", "info", "tensorize",
+                f"ensemble not tensorizable: {e}")
+        return [rep]
+    rungs = []
+    r = min_bucket
+    while r < max_batch_rows:
+        rungs.append(r)
+        r *= 2
+    rungs.append(max_batch_rows)
+    ce.warm(rungs)
+    bound = len(rungs)
+    sigs = ce.compiled_signatures()
+    if sigs > bound:
+        rep.add("TD201", "error", "bucket_ladder",
+                f"{sigs} compiled signatures after warming the "
+                f"{bound}-rung ladder; the registry's publish gate "
+                "promises zero on-path compiles beyond it")
+    hlo = ce.lower_serving(rows=min_bucket).as_text()
+    return [rep.apply_allowlist(allow),
+            lint_hlo(hlo, label=f"{label}/hlo",
+                     allowed_phases=frozenset(), allow=allow)]
+
+
 def doctor_fused_split(*, label: str = "fused_split",
                        R: int = 256, F: int = 16, B: int = 12,
                        allow: Sequence[Tuple[str, str]] = ()
@@ -397,6 +443,7 @@ def run_doctor(configs: Optional[Sequence[str]] = None,
     if first_bst is not None:
         reports += doctor_predict(first_bst, allow=allow)
         reports += doctor_batcher(first_bst, allow=allow)
+        reports += doctor_serving(first_bst, allow=allow)
     return reports
 
 

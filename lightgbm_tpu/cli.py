@@ -72,6 +72,7 @@ def serve(params: Dict[str, str],
                          "(or model=name:file[,name:file...])")
     registry = ModelRegistry(
         warmup_rows=int(params.get("warmup_rows", 256)))
+    truthy = ("1", "true", "yes", "on")
     server = PredictionServer(
         registry,
         host=params.get("host", "127.0.0.1"),
@@ -80,7 +81,12 @@ def serve(params: Dict[str, str],
         max_wait_us=int(params.get("max_wait_us", 2000)),
         max_queue_rows=(int(params["max_queue_rows"])
                         if "max_queue_rows" in params else None),
-        min_bucket=int(params.get("min_bucket", 16)))
+        min_bucket=int(params.get("min_bucket", 16)),
+        replicas=int(params.get("replicas", 0)),
+        compiled_predict=(str(params.get("compiled_predict", ""))
+                          .lower() in truthy),
+        qps_budget=(float(params["qps_budget"])
+                    if "qps_budget" in params else None))
     for item in str(spec).split(","):
         item = item.strip()
         if not item:

@@ -12,8 +12,10 @@ zero-downtime deploys (``registry``), request-level observability
 from .batcher import MicroBatcher, Overloaded, bucket_rows
 from .metrics import Counter, RingHistogram, ServingMetrics
 from .registry import ModelRegistry, ModelVersion
+from .replica import BudgetExceeded, QpsBudget, ReplicaSet
 from .server import PredictionServer
 
 __all__ = ["MicroBatcher", "Overloaded", "bucket_rows", "Counter",
            "RingHistogram", "ServingMetrics", "ModelRegistry",
-           "ModelVersion", "PredictionServer"]
+           "ModelVersion", "PredictionServer", "ReplicaSet",
+           "QpsBudget", "BudgetExceeded"]

@@ -79,7 +79,7 @@ def bucket_rows(n: int, min_bucket: int, max_batch_rows: int) -> int:
 
 class _Pending:
     __slots__ = ("X", "done", "result", "error", "tag", "t_enqueue",
-                 "abandoned")
+                 "abandoned", "callback")
 
     def __init__(self, X: np.ndarray):
         self.X = X
@@ -89,6 +89,13 @@ class _Pending:
         self.tag = None
         self.t_enqueue = time.monotonic()
         self.abandoned = False
+        self.callback = None
+
+    def fire(self):
+        self.done.set()
+        cb = self.callback
+        if cb is not None:
+            cb(self.result, self.error, self.tag)
 
 
 class MicroBatcher:
@@ -145,23 +152,7 @@ class MicroBatcher:
     def submit_tagged(self, X, timeout: Optional[float] = None
                       ) -> Tuple[np.ndarray, object]:
         """`submit`, also returning the batch's model tag (version)."""
-        X = np.ascontiguousarray(X, np.float64)
-        if X.ndim == 1:
-            X = X[None, :]
-        if X.ndim != 2 or X.shape[0] == 0:
-            raise ValueError("submit expects a nonempty 1-D row or "
-                             "2-D [rows, features] matrix")
-        p = _Pending(X)
-        with self._cond:
-            if self._closed:
-                raise RuntimeError("batcher is closed")
-            if self._queued_rows + len(X) > self.max_queue_rows:
-                self.metrics.on_overload()
-                raise Overloaded(self._queued_rows, self.max_queue_rows)
-            self._queue.append(p)
-            self._queued_rows += len(X)
-            self._cond.notify_all()
-        self.metrics.on_request(self.model, len(X))
+        p = self._enqueue(X)
         if not p.done.wait(timeout):
             # unregister the abandoned promise: if it is still queued,
             # remove it (its rows must stop counting against admission
@@ -177,6 +168,43 @@ class MicroBatcher:
             raise p.error
         return p.result, p.tag
 
+    def submit_async(self, X, callback: Callable) -> None:
+        """Enqueue ``X`` and return immediately; ``callback(result,
+        error, tag)`` fires exactly once, on the batcher worker thread,
+        when the batch lands. The async front-end's entry point: no
+        thread parks per request. Admission failures (:class:
+        `Overloaded`, closed, bad shape) still raise synchronously —
+        the caller holds the connection and maps them itself.
+        """
+        self._enqueue(X, callback)
+
+    def _enqueue(self, X, callback: Optional[Callable] = None
+                 ) -> _Pending:
+        X = np.ascontiguousarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        if X.ndim != 2 or X.shape[0] == 0:
+            raise ValueError("submit expects a nonempty 1-D row or "
+                             "2-D [rows, features] matrix")
+        p = _Pending(X)
+        p.callback = callback   # attach BEFORE the worker can see it
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            if self._queued_rows + len(X) > self.max_queue_rows:
+                self.metrics.on_overload()
+                raise Overloaded(self._queued_rows, self.max_queue_rows)
+            self._queue.append(p)
+            self._queued_rows += len(X)
+            self._cond.notify_all()
+        self.metrics.on_request(self.model, len(X))
+        return p
+
+    def load(self) -> int:
+        """Rows queued right now — the replica router's depth signal."""
+        with self._cond:
+            return self._queued_rows
+
     def close(self, drain: bool = True):
         """Stop the worker; ``drain`` runs queued requests first, else
         they fail with a closed error."""
@@ -185,7 +213,7 @@ class MicroBatcher:
             if not drain:
                 for p in self._queue:
                     p.error = RuntimeError("batcher closed")
-                    p.done.set()
+                    p.fire()
                 self._queue.clear()
                 self._queued_rows = 0
             self._cond.notify_all()
@@ -250,14 +278,19 @@ class MicroBatcher:
             for p in batch:
                 self.metrics.on_error(self.model)
                 p.error = e
-                p.done.set()
+                p.fire()
             return
         compute_s = time.monotonic() - t0
         self.metrics.on_batch(rows, t0 - batch[0].t_enqueue, compute_s)
+        for p in batch:
+            # each request's own wait, row-weighted — the per-batch
+            # observation above only sees the oldest request, which
+            # under-weights coalesced bursts (ISSUE 15)
+            self.metrics.on_request_wait(t0 - p.t_enqueue, len(p.X))
         off = 0
         for p in batch:
             if not p.abandoned:   # timed-out caller left; don't fill
                 p.result = out[off:off + len(p.X)]
                 p.tag = tag
             off += len(p.X)
-            p.done.set()
+            p.fire()

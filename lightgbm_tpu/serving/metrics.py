@@ -48,7 +48,17 @@ class ServingMetrics:
     serve_rows_per_s                          window throughput gauge
     serve_swaps_total / serve_rollbacks_total registry movements
     serve_uptime_seconds                      since metrics creation
+    serve_request_wait_seconds{quantile=}     per-REQUEST enqueue wait
+    serve_row_wait_p99                        row-weighted wait p99
+    serve_budget_rejected_total{model=}       QPS-budget admission fails
     ========================================  =============================
+
+    ``serve_queue_wait_seconds`` observes once per BATCH (the oldest
+    request's wait) — under a coalesced burst that under-weights the
+    many requests that joined late. ``serve_request_wait_seconds``
+    observes every request, and ``serve_row_wait_p99`` weights each
+    request's wait by its row count, so a 1000-row straggler moves the
+    tail the way 1000 single-row stragglers would (ISSUE 15 satellite).
     """
 
     def __init__(self, hist_size: int = 4096):
@@ -60,9 +70,16 @@ class ServingMetrics:
         self.batches_total = Counter()
         self.swaps_total = Counter()
         self.rollbacks_total = Counter()
+        self.budget_rejected_total: Dict[str, Counter] = {}
         self.batch_rows = RingHistogram(hist_size)
         self.queue_wait_s = RingHistogram(hist_size)
         self.compute_s = RingHistogram(hist_size)
+        self.request_wait_s = RingHistogram(hist_size)
+        # paired rings (same observe cadence): each request's wait next
+        # to its row count, so the row-weighted percentile can be
+        # recomputed over the retained window at render time
+        self._req_wait = RingHistogram(hist_size)
+        self._req_rows = RingHistogram(hist_size)
         # (monotonic_ts, rows) per batch: windowed rows/s gauge
         self._thru = RingHistogram(hist_size)
         self._thru_ts = RingHistogram(hist_size)
@@ -94,6 +111,34 @@ class ServingMetrics:
         self.compute_s.observe(compute_s)
         self._thru.observe(float(rows))
         self._thru_ts.observe(now)
+
+    def on_request_wait(self, wait_s: float, rows: int):
+        """Per-request wait at batch start (one call per request of the
+        batch, row count attached for the weighted tail)."""
+        self.request_wait_s.observe(wait_s)
+        self._req_wait.observe(wait_s)
+        self._req_rows.observe(float(rows))
+
+    def on_budget_rejected(self, model: str):
+        self._labelled(self.budget_rejected_total, model).inc()
+
+    def row_wait_p99(self) -> float:
+        """Row-weighted p99 of request wait over the retained window:
+        the wait below which 99% of ROWS (not requests) started."""
+        w = self._req_wait.window()
+        r = self._req_rows.window()
+        m = min(w.size, r.size)      # rings race by at most one slot
+        if m == 0:
+            return 0.0
+        w, r = w[:m], r[:m]
+        order = w.argsort()
+        w, r = w[order], r[order]
+        cum = r.cumsum()
+        total = cum[-1]
+        if total <= 0:
+            return float(w[-1])
+        idx = int((cum >= 0.99 * total).argmax())
+        return float(w[idx])
 
     def mean_batch_rows(self) -> float:
         return self.batch_rows.summary()[2]
@@ -147,4 +192,16 @@ class ServingMetrics:
         out.append("# TYPE serve_uptime_seconds gauge")
         out.append(
             f"serve_uptime_seconds {time.monotonic() - self._t0:.3f}")
+        render_summary(out, "serve_request_wait_seconds",
+                       "Per-request enqueue to batch start",
+                       self.request_wait_s)
+        out.append("# HELP serve_row_wait_p99 Row-weighted wait p99")
+        out.append("# TYPE serve_row_wait_p99 gauge")
+        out.append(f"serve_row_wait_p99 {self.row_wait_p99():.9g}")
+        render_counter(out, "serve_budget_rejected_total",
+                       "Requests rejected by per-model QPS budgets",
+                       [(f'{{model="{m}"}}', c.value)
+                        for m, c in
+                        sorted(self.budget_rejected_total.items())] or
+                       [("", 0)])
         return "\n".join(out) + "\n"

@@ -45,12 +45,16 @@ __all__ = ["ModelRegistry", "ModelVersion"]
 
 
 class ModelVersion:
-    """One immutable (booster, warmed session) pair. The registry hands
-    these out by reference; holders may predict on them at any time,
-    even after the version was superseded."""
+    """One immutable (booster, warmed session[, compiled, replicas])
+    snapshot. The registry hands these out by reference; holders may
+    predict on them at any time, even after the version was
+    superseded. ``compiled`` / ``replicas`` are populated off-path by
+    ``_load`` when serving is configured — publishing the version
+    publishes all three in the same single reference store."""
 
     __slots__ = ("name", "version", "source", "booster", "session",
-                 "loaded_at", "num_features")
+                 "loaded_at", "num_features", "compiled", "replicas",
+                 "compiled_fallback")
 
     def __init__(self, name: str, version: int, source: str,
                  booster, session):
@@ -61,12 +65,29 @@ class ModelVersion:
         self.session = session
         self.loaded_at = time.time()
         self.num_features = booster.num_feature()
+        self.compiled = None          # codegen.CompiledEnsemble | None
+        self.replicas = None          # replica.ReplicaSet | None
+        self.compiled_fallback = None  # why compiled is None (str)
+
+    def close_replicas(self, drain: bool = True):
+        """Retire this version's replica fleet (history eviction /
+        unregister); the session path stays usable."""
+        rs, self.replicas = self.replicas, None
+        if rs is not None:
+            rs.close(drain=drain)
 
     def describe(self) -> dict:
-        return {"name": self.name, "version": self.version,
-                "source": self.source, "loaded_at": self.loaded_at,
-                "num_features": self.num_features,
-                "num_trees": self.booster.num_trees()}
+        d = {"name": self.name, "version": self.version,
+             "source": self.source, "loaded_at": self.loaded_at,
+             "num_features": self.num_features,
+             "num_trees": self.booster.num_trees()}
+        if self.compiled is not None:
+            d["compiled"] = self.compiled.describe()
+        elif self.compiled_fallback is not None:
+            d["compiled_fallback"] = self.compiled_fallback
+        if self.replicas is not None:
+            d["replicas"] = self.replicas.describe()
+        return d
 
 
 class ModelRegistry:
@@ -74,15 +95,45 @@ class ModelRegistry:
     are lock-free (one attribute load resolves the active version)."""
 
     def __init__(self, *, warmup_rows: int = 256, history: int = 4,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 compiled_predict: bool = False, replicas: int = 0):
         self.warmup_rows = int(warmup_rows)
         self.history = int(history)
         self.metrics = metrics or ServingMetrics()
+        self.compiled_predict = bool(compiled_predict)
+        self.replicas = int(replicas)
+        self.warm_ladder: Optional[List[int]] = None
+        self.replica_devices = None
+        self.replica_batcher_opts: Dict[str, object] = {}
         self._lock = threading.Lock()          # writers only
         self._active: Dict[str, ModelVersion] = {}
         self._history: Dict[str, List[ModelVersion]] = {}
         self._next_version: Dict[str, int] = {}
         self._default: Optional[str] = None
+
+    def configure_serving(self, *, compiled_predict: Optional[bool] = None,
+                          replicas: Optional[int] = None,
+                          warm_ladder: Optional[List[int]] = None,
+                          devices=None,
+                          batcher_opts: Optional[Dict] = None):
+        """Set the serving shape applied to every subsequent ``_load``
+        (already-published versions are not rebuilt — swap to apply).
+
+        ``warm_ladder`` is the full batch-bucket ladder; every rung is
+        compiled per replica OFF the serving path so publish means
+        ZERO compiles on live traffic (ISSUE 15 satellite — warming
+        only the max rung left every smaller first-request paying
+        compile latency in-band)."""
+        if compiled_predict is not None:
+            self.compiled_predict = bool(compiled_predict)
+        if replicas is not None:
+            self.replicas = int(replicas)
+        if warm_ladder is not None:
+            self.warm_ladder = [int(r) for r in warm_ladder]
+        if devices is not None:
+            self.replica_devices = list(devices)
+        if batcher_opts is not None:
+            self.replica_batcher_opts = dict(batcher_opts)
 
     # -- loading / swapping -------------------------------------------
     def _load(self, name: str, source, **session_kwargs) -> ModelVersion:
@@ -96,12 +147,39 @@ class ModelRegistry:
             raise TypeError("model source must be a Booster or a model "
                             f"file path, got {type(source).__name__}")
         session = booster.predict_session(**session_kwargs)
+        # warm the WHOLE batch ladder, not just one rung: executables
+        # cache per shape, so a single-rung warmup still left the first
+        # live request at every other rung paying compile in-band
+        ladder = self.warm_ladder or [self.warmup_rows]
         if self.warmup_rows > 0:
-            session.warmup(self.warmup_rows)
+            for rows in sorted(set(ladder)):
+                session.warmup(rows)
         with self._lock:
             v = self._next_version.get(name, 0) + 1
             self._next_version[name] = v
-        return ModelVersion(name, v, src, booster, session)
+        mv = ModelVersion(name, v, src, booster, session)
+        if self.compiled_predict or self.replicas > 0:
+            from ..codegen import CompiledEnsemble
+            try:
+                mv.compiled = CompiledEnsemble(booster,
+                                               **session_kwargs)
+            except (ValueError, TypeError) as e:
+                # named fallback, same discipline as fused_split=auto:
+                # the session path serves, /models says why
+                mv.compiled_fallback = str(e)
+        if mv.compiled is not None:
+            if self.replicas > 0:
+                from .replica import ReplicaSet
+                mv.replicas = ReplicaSet(
+                    mv.compiled, mv, replicas=self.replicas,
+                    devices=self.replica_devices,
+                    metrics=self.metrics, model=name,
+                    **self.replica_batcher_opts)
+                if self.warmup_rows > 0:
+                    mv.replicas.warm(ladder)
+            elif self.warmup_rows > 0:
+                mv.compiled.warm(sorted(set(ladder)))
+        return mv
 
     def register(self, name: str, source,
                  **session_kwargs) -> ModelVersion:
@@ -109,20 +187,29 @@ class ModelRegistry:
         version of ``name``. The first registered name becomes the
         default model."""
         mv = self._load(name, source, **session_kwargs)
+        evicted: List[ModelVersion] = []
         with self._lock:
             old = self._active.get(name)
             if old is not None:
                 hist = self._history.setdefault(name, [])
                 hist.append(old)
+                evicted = hist[:-self.history]
                 del hist[:-self.history]
                 self.metrics.swaps_total.inc()
                 from ..telemetry.events import record_serving
                 record_serving("swap", name, mv.version)
             # the publish: one reference store, atomic under the GIL —
-            # in-flight readers keep `old`, new resolves see `mv`
+            # in-flight readers keep `old`, new resolves see `mv`.
+            # `mv` already carries its compiled program and warmed
+            # replica fleet, so (version, compiled, replicas) is ONE
+            # atomic snapshot
             self._active[name] = mv
             if self._default is None:
                 self._default = name
+        for ev in evicted:
+            # aged past the rollback ring: its replica batchers are
+            # unreachable — retire them (outside the lock; drain)
+            ev.close_replicas()
         return mv
 
     # a swap IS a register on an existing name; the alias keeps the
@@ -147,10 +234,22 @@ class ModelRegistry:
 
     def unregister(self, name: str):
         with self._lock:
-            self._active.pop(name, None)
-            self._history.pop(name, None)
+            dropped = [self._active.pop(name, None)]
+            dropped += self._history.pop(name, [])
             if self._default == name:
                 self._default = next(iter(self._active), None)
+        for mv in dropped:
+            if mv is not None:
+                mv.close_replicas()
+
+    def close(self):
+        """Retire every version's replica fleet (server shutdown)."""
+        with self._lock:
+            all_mv = list(self._active.values())
+            for hist in self._history.values():
+                all_mv += hist
+        for mv in all_mv:
+            mv.close_replicas()
 
     # -- serving side (lock-free) -------------------------------------
     def resolve(self, name: Optional[str] = None) -> ModelVersion:
@@ -166,8 +265,13 @@ class ModelRegistry:
                 ) -> Tuple[np.ndarray, ModelVersion]:
         """Predict entirely on one resolved version; returns
         ``(result, version)`` so callers (the batcher) can tag results
-        with the model that produced them."""
+        with the model that produced them. Prefers the tensorized
+        program when the version carries one (bit-identical by the
+        CompiledEnsemble contract; replicated routing lives in the
+        server, which talks to ``mv.replicas`` directly)."""
         mv = self.resolve(name)
+        if mv.compiled is not None:
+            return mv.compiled.predict(X), mv
         return mv.session.predict(X), mv
 
     # -- introspection -------------------------------------------------

@@ -372,6 +372,16 @@ def staged_cost_reports(bst, *,
         X = jnp.zeros((rows, F), jnp.float32)
         c = jax.jit(_walk).lower(ens, X).compile()
         reports[label] = cost_report(c, label)
+    # the tensorized serving program (ISSUE 15): same 256-row shape as
+    # the packed walk above, so the two predict paths gate against the
+    # same lattice
+    try:
+        from ..codegen import CompiledEnsemble
+        ce = CompiledEnsemble(bst)
+        reports["compiled_predict"] = cost_report(
+            ce.lower_serving(rows=256), "compiled_predict")
+    except (ValueError, TypeError):   # non-tensorizable model: skip
+        pass
     if len(jax.devices()) >= 2:
         try:
             reports["tree_builder"] = _tree_builder_report()

@@ -110,6 +110,14 @@ DEFAULT_TOLERANCES: Dict[str, Tolerance] = {
     "hist_bytes_fused": Tolerance("static", 1.1),
     "hist_fused_bytes_reduction": Tolerance("static", 1.1),
     "split_scan_ms": Tolerance("time", 2.5),
+    # serving fleet (ISSUE 15): rows/s + tail latency through the async
+    # front end on a loaded CI host — wide bands; the tensorized
+    # program's price is static like every other compiled program
+    "serve_rows_per_s": Tolerance("throughput", 2.5),
+    "serve_p99_ms": Tolerance("time", 2.5),
+    "compiled_predict_speedup": Tolerance("throughput", 2.5),
+    "cost_compiled_predict_flops": Tolerance("static", 1.25),
+    "cost_compiled_predict_bytes": Tolerance("static", 1.25),
 }
 _DEFAULT = Tolerance("static", 1.5)
 

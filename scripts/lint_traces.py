@@ -3,7 +3,9 @@
 
 Runs the static-analysis passes (``lightgbm_tpu/analysis/``) over the
 repo's hot-path entry points — fused boosting step, data-parallel tree
-builder, packed-ensemble predict walk, serving micro-batcher — for
+builder, packed-ensemble predict walk, serving micro-batcher, and the
+tensorized compiled-ensemble serving program (no host callbacks
+(TD002), ladder-bounded signatures (TD201)) — for
 every canonical config cell (plain / EFB / quantized / categorical /
 multiclass / nan_guard / telemetry × serial / data-parallel) on the
 8-virtual-device CPU mesh. The telemetry cell trains with the full

@@ -49,6 +49,13 @@ N_ROWS, N_FEATS, NUM_LEAVES = 20_000, 16, 31
 WARMUP_ROUNDS, TIMED_ROUNDS = 8, 40
 # out-of-core probe workload (bench.ingest_bench shares the shape)
 INGEST_ROWS, INGEST_ITERS = 1 << 16, 6
+# serving fleet probe (bench.fleet_bench, ISSUE 15): a trimmed version
+# of the bench's 1/2/4/8 x 64-client ablation — the gate only needs
+# the walk-vs-compiled ratio and one stable throughput/latency figure.
+# Replica scaling is bench territory: on the gate's pinned single CPU
+# device extra replicas only measure lock contention, so the gated
+# numbers are the single-replica fleet at a lighter client load.
+SERVE_CLIENTS, SERVE_REPLICAS = 16, (1,)
 # histogram probe lattice — identical to bench.probe_hist_impl so the
 # two surfaces gate the same program
 HIST_R, HIST_F, HIST_B, HIST_L = 1 << 17, 28, 63, 21
@@ -121,14 +128,18 @@ def collect_metrics(skip_timing: bool = False
     _INGEST_METRICS = ("ingest_rows_per_s", "ingest_prefetch_overlap",
                        "ingest_chunked_ms_per_tree",
                        "ingest_resident_ms_per_tree")
+    _SERVE_METRICS = ("serve_rows_per_s", "serve_p99_ms",
+                      "compiled_predict_speedup")
     if skip_timing:
         skipped.extend(("ms_per_tree", "split_scan_ms"))
         skipped.extend(_INGEST_METRICS)
+        skipped.extend(_SERVE_METRICS)
     elif not perf.host_quiet():
         print("perf-gate: host not quiet (loadavg); skipping timing",
               file=sys.stderr)
         skipped.extend(("ms_per_tree", "split_scan_ms"))
         skipped.extend(_INGEST_METRICS)
+        skipped.extend(_SERVE_METRICS)
     else:
         gb = bst._gbdt
         for _ in range(WARMUP_ROUNDS):
@@ -159,6 +170,23 @@ def collect_metrics(skip_timing: bool = False
             print(f"perf-gate: split-scan probe failed ({e}); skipping",
                   file=sys.stderr)
             skipped.append("split_scan_ms")
+        # serving fleet (ISSUE 15): compiled-ensemble replicas vs the
+        # packed walk, through the real HTTP front end via
+        # bench.fleet_bench so the gate prices the bench's path
+        try:
+            import numpy as np
+
+            from bench import fleet_bench
+            Xv = np.random.default_rng(7).normal(
+                size=(64, N_FEATS)).astype(np.float32)
+            flt = fleet_bench(bst, Xv, replica_counts=SERVE_REPLICAS,
+                              clients=SERVE_CLIENTS, reqs_each=4)
+            metrics.update({k: float(v) for k, v in flt.items()
+                            if k in _SERVE_METRICS})
+        except Exception as e:  # noqa: BLE001 — probe must not kill gate
+            print(f"perf-gate: serve probe failed ({e}); skipping",
+                  file=sys.stderr)
+            skipped.extend(_SERVE_METRICS)
     return metrics, skipped
 
 

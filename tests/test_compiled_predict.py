@@ -1,0 +1,127 @@
+"""Tensorized compiled-ensemble inference (ISSUE 15 tentpole):
+bit-parity of the single-XLA-program walk against PredictSession
+across the decision-type matrix — categorical bitsets, NaN missing,
+zero_as_missing, multiclass, leaf indices — plus the ladder-warm
+zero-on-path-compiles contract the registry publishes behind.
+
+Feature values are grid-quantized (multiples of 1/8) so f32 device
+thresholds and f64 host thresholds can never straddle a sample:
+parity is then exact by construction, and any mismatch is a real
+semantics bug, not float noise.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.codegen import CompiledEnsemble
+
+_BASE = {"verbosity": -1, "num_leaves": 15, "min_data_in_leaf": 5,
+         "learning_rate": 0.2}
+
+
+def _grid(rng, n, f):
+    return np.round(rng.normal(size=(n, f)) * 8) / 8.0
+
+
+def _train(params, X, y, **ds_kw):
+    ds = lgb.Dataset(X, label=y, free_raw_data=False, **ds_kw)
+    return lgb.train(dict(_BASE, **params), ds, num_boost_round=5)
+
+
+def _cat_nan_data(seed=3, n=600, f=6):
+    rng = np.random.RandomState(seed)
+    X = _grid(rng, n, f)
+    X[rng.rand(n, f) < 0.1] = np.nan
+    # categorical column AFTER the NaN sprinkle so the codes stay
+    # integral; its own missings are injected explicitly
+    X[:, 0] = rng.randint(0, 8, size=n).astype(np.float64)
+    X[rng.rand(n) < 0.1, 0] = np.nan
+    y = ((np.nan_to_num(X[:, 1]) + (X[:, 0] == 3)) > 0.2).astype(float)
+    return X, y
+
+
+def test_parity_categorical_nan_missing():
+    """Bitset categorical decisions + NaN-missing routing, bit-for-bit
+    against the per-tree PredictSession walk."""
+    X, y = _cat_nan_data()
+    bst = _train({"objective": "binary"}, X, y,
+                 categorical_feature=[0])
+    ce = CompiledEnsemble(bst)
+    assert np.array_equal(ce.predict(X), bst.predict_session().predict(X))
+
+
+def test_parity_zero_as_missing():
+    rng = np.random.RandomState(5)
+    X = _grid(rng, 500, 5)
+    X[rng.rand(500, 5) < 0.25] = 0.0   # exact zeros route as missing
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = _train({"objective": "binary", "zero_as_missing": True}, X, y)
+    ce = CompiledEnsemble(bst)
+    assert np.array_equal(ce.predict(X), bst.predict_session().predict(X))
+
+
+def test_parity_multiclass_and_raw_score():
+    rng = np.random.RandomState(7)
+    X = _grid(rng, 600, 6)
+    y = (X[:, :3] + 0.5 * rng.normal(size=(600, 3))).argmax(1) \
+        .astype(float)
+    bst = _train({"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 7}, X, y)
+    assert np.array_equal(CompiledEnsemble(bst).predict(X),
+                          bst.predict_session().predict(X))
+    assert np.array_equal(
+        CompiledEnsemble(bst, raw_score=True).predict(X),
+        bst.predict_session(raw_score=True).predict(X))
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    rng = np.random.RandomState(11)
+    X = _grid(rng, 500, 5)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    return X, _train({"objective": "binary"}, X, y)
+
+
+def test_parity_leaf_index(binary_model):
+    X, bst = binary_model
+    got = CompiledEnsemble(bst, pred_leaf=True).predict(X)
+    want = bst.predict_session(pred_leaf=True).predict(X)
+    assert got.dtype.kind == want.dtype.kind == "i"
+    assert np.array_equal(got, want)
+
+
+def test_ladder_warm_zero_onpath_compiles(binary_model):
+    """Warming the batch ladder compiles exactly one signature per
+    rung; replaying every rung afterwards must trigger ZERO backend
+    compiles — the registry's publish gate depends on this."""
+    from lightgbm_tpu.analysis.recompile_guard import RecompileGuard
+    X, bst = binary_model
+    ce = CompiledEnsemble(bst)
+    rungs = (8, 16, 32)
+    ce.warm(rungs)
+    assert ce.compiled_signatures() == len(rungs)
+    sess = bst.predict_session()   # reference for post-warm parity
+    with RecompileGuard(max_compiles=0, label="compiled_serving"):
+        for r in rungs:
+            Z = np.ascontiguousarray(X[:r])
+            assert np.array_equal(ce.predict(Z), sess.predict(Z))
+    assert ce.compiled_signatures() == len(rungs)
+
+
+def test_window_and_version_guard():
+    """start/num_iteration windows match the session's view, and a
+    mutated booster invalidates the compiled snapshot (own booster —
+    the module fixture must stay unmutated)."""
+    rng = np.random.RandomState(13)
+    X = _grid(rng, 300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    bst = _train({"objective": "binary", "num_leaves": 7}, X, y)
+    ce = CompiledEnsemble(bst, start_iteration=1, num_iteration=2)
+    got = ce.predict(X)
+    want = bst.predict_session(start_iteration=1,
+                               num_iteration=2).predict(X)
+    assert np.array_equal(got, want)
+    bst.update()
+    with pytest.raises(RuntimeError):
+        ce.predict(X[:8])

@@ -1,0 +1,178 @@
+"""Replica fleet + admission (ISSUE 15): least-queue-depth routing,
+whole-version results under a mid-burst hot-swap across replicas, the
+drain/restore device runbook, per-model QPS budgets, and the
+row-weighted request-wait tail metric."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (BudgetExceeded, PredictionServer,
+                                  QpsBudget, ReplicaSet)
+
+
+class _StubCompiled:
+    """CompiledEnsemble stand-in: deterministic, optionally gated so a
+    replica can be held busy while the router is probed."""
+
+    num_features = 4
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+
+    def predict(self, X, device=None):
+        self.gate.wait(10)
+        return np.asarray(X, np.float64)[:, 0]
+
+    def compiled_signatures(self):
+        return 0
+
+
+def test_least_queue_routing_and_drain_runbook():
+    stub = _StubCompiled()
+    rs = ReplicaSet(stub, replicas=2, max_batch_rows=64,
+                    max_wait_us=0, min_bucket=8)
+    try:
+        stub.gate.clear()
+        # hold replica 0: one request in flight, one queued behind it
+        done = []
+
+        def jam():
+            rs.replicas[0].batcher.submit(np.ones((4, 4)), timeout=10)
+            done.append(rs.replicas[0].batcher.submit(
+                np.ones((4, 4)), timeout=10))
+
+        t = threading.Thread(target=jam)
+        t.start()
+        deadline = time.monotonic() + 5
+        while (rs.replicas[0].batcher.load() == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        assert rs.replicas[0].batcher.load() > 0
+        assert rs.pick() is rs.replicas[1]
+        stub.gate.set()
+        t.join()
+        assert len(done) == 1
+
+        # runbook: drain replica 0, route around it, restore it
+        rs.drain_replica(0)
+        assert rs.pick() is rs.replicas[1]
+        with pytest.raises(RuntimeError):
+            rs.drain_replica(1)      # never drain the last live replica
+        rs.restore_replica(0)
+        out, tag = rs.submit_tagged(np.ones((3, 4)))
+        np.testing.assert_array_equal(out, [1.0, 1.0, 1.0])
+        assert tag is rs.tag
+    finally:
+        stub.gate.set()
+        rs.close()
+
+
+def test_qps_budget_token_bucket():
+    q = QpsBudget(qps=5, burst=2)
+    assert q.try_admit()
+    assert q.try_admit()
+    assert not q.try_admit()         # bucket empty, no refill yet
+    time.sleep(0.3)                  # ~1.5 tokens back at 5/s
+    assert q.try_admit()
+
+
+def _model(rng, n=400, f=5, iters=4, shift=0.0):
+    X = np.round(rng.normal(size=(n, f)) * 8) / 8.0
+    y = (X[:, 0] + 0.5 * X[:, 1] + shift * X[:, 2] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1},
+                    lgb.Dataset(X, label=y, free_raw_data=False), iters)
+    return X, bst
+
+
+@pytest.fixture(scope="module")
+def two_versions(tmp_path_factory):
+    td = tmp_path_factory.mktemp("fleet")
+    rng = np.random.RandomState(0)
+    X, b1 = _model(rng)
+    _, b2 = _model(rng, shift=0.9)
+    f1, f2 = str(td / "v1.txt"), str(td / "v2.txt")
+    b1.save_model(f1)
+    b2.save_model(f2)
+    return X, b1, b2, f1, f2
+
+
+def test_hot_swap_whole_version_across_replicas(two_versions):
+    """Mid-burst swap with a 2-replica compiled fleet: every result
+    matches exactly one WHOLE version — no request ever sees a mix,
+    no matter which replica served it. Also exercises the per-request
+    wait hook behind serve_row_wait_p99."""
+    X, b1, b2, f1, f2 = two_versions
+    srv = PredictionServer(max_batch_rows=64, min_bucket=16,
+                           max_wait_us=500, compiled_predict=True,
+                           replicas=2)
+    try:
+        srv.registry.register("m", f1)
+        Xq = np.ascontiguousarray(X[:8])
+        # bit-exact references: same save/load roundtrip the registry
+        # performs, through the session path the compiled walk matches
+        exp1 = lgb.Booster(model_file=f1).predict_session().predict(Xq)
+        exp2 = lgb.Booster(model_file=f2).predict_session().predict(Xq)
+        assert not np.allclose(exp1, exp2)   # swap must be observable
+        errors, mixed, versions = [], [], set()
+        stop = threading.Event()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    out, ver = srv.predict(Xq, "m")
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+                versions.add(ver)
+                m1 = bool(np.array_equal(out, exp1))
+                m2 = bool(np.array_equal(out, exp2))
+                if m1 == m2:
+                    mixed.append(np.asarray(out))
+
+        threads = [threading.Thread(target=client) for _ in range(6)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        srv.registry.register("m", f2)       # hot swap mid-burst
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errors
+        assert not mixed, f"mixed-version results: {mixed[:2]}"
+        assert len(versions) == 2            # the swap landed mid-burst
+        assert srv.metrics.request_wait_s.count > 0
+        assert srv.metrics.row_wait_p99() >= 0.0
+        assert "serve_row_wait_p99" in srv.metrics.render()
+    finally:
+        srv.stop()
+
+
+def test_qps_budget_rejects_through_server(two_versions):
+    """Admission fires before the batcher or fleet sees the request:
+    BudgetExceeded is retriable and counted per model."""
+    X, _, _, f1, _ = two_versions
+    srv = PredictionServer(max_batch_rows=32, min_bucket=16,
+                           max_wait_us=0, qps_budget=2.0)
+    try:
+        srv.registry.register("m", f1)
+        Xq = np.ascontiguousarray(X[:4])
+        admitted = rejected = 0
+        for _ in range(8):
+            try:
+                srv.predict(Xq, "m")
+                admitted += 1
+            except BudgetExceeded as e:
+                assert e.retriable
+                rejected += 1
+        assert admitted >= 1 and rejected >= 1
+        assert srv.metrics.budget_rejected_total["m"].value == rejected
+        assert "serve_budget_rejected_total" in srv.metrics.render()
+    finally:
+        srv.stop()
